@@ -1,0 +1,20 @@
+"""sav_tpu — a TPU-native vision self-attention framework.
+
+A ground-up JAX / XLA / pjit / Pallas re-design with the capabilities of
+``cfoster0/self-attention-experiments-vision`` (see SURVEY.md): a vision
+attention layer zoo, a model zoo (ViT, CaiT, CvT, CeiT, TNT, BoTNet,
+MLP-Mixer), a sharded ImageNet input pipeline, and an SPMD training stack
+over a ``jax.sharding.Mesh`` with fused Pallas TPU flash-attention kernels
+behind a ``backend='pallas'`` seam.
+
+Subpackages
+-----------
+- ``sav_tpu.ops``      — functional compute ops (attention cores, Pallas kernels)
+- ``sav_tpu.models``   — layer zoo + model zoo + registry
+- ``sav_tpu.utils``    — metrics, logging
+- ``sav_tpu.parallel`` — mesh, sharding rules, ring attention (sequence parallel)
+- ``sav_tpu.data``     — input pipeline (fake data, tf.data, augmentations)
+- ``sav_tpu.train``    — pjit trainer, schedules, checkpointing
+"""
+
+__version__ = "0.1.0"

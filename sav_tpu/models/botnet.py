@@ -1,0 +1,160 @@
+"""BoTNet — Bottleneck Transformers.
+
+Reference: /root/reference/models/botnet.py:17-331 — a ResNet-50-style
+backbone whose final stage replaces the 3×3 conv with 2-D relative-position
+MHSA. The reference version never ran (AttributeErrors + a wrong output
+einsum, SURVEY.md §2.9 #1-3); this is the working TPU rebuild: the relative
+logits come from :mod:`sav_tpu.ops.relative` and attention runs on the shared
+Pallas/XLA seam (the bias rides the fused softmax).
+
+Uses BatchNorm → the trainer threads ``batch_stats`` (the reference needed a
+separate ``base_with_state.py`` trainer; here one trainer handles both).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from sav_tpu.models.layers import BoTMHSA, SqueezeExciteBlock
+
+Dtype = Any
+
+
+class BottleneckResNetBlock(nn.Module):
+    """1×1 → 3×3(stride) → 1×1 convs + BN + swish, optional SE, zero-init
+    final BN scale (botnet.py:17-67)."""
+
+    filters: int
+    strides: int = 1
+    se_ratio: Optional[float] = 0.25
+    activation_fn: Callable = nn.swish
+    dtype: Dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, inputs: jax.Array, is_training: bool) -> jax.Array:
+        norm = lambda name, **kw: nn.BatchNorm(
+            use_running_average=not is_training,
+            momentum=0.9,
+            dtype=self.dtype,
+            name=name,
+            **kw,
+        )
+        conv = lambda feats, k, s, name: nn.Conv(
+            feats, (k, k), strides=(s, s), padding="SAME", use_bias=False,
+            dtype=self.dtype, name=name,
+        )
+        residual = inputs
+        x = conv(self.filters, 1, 1, "conv1")(inputs)
+        x = self.activation_fn(norm("bn1")(x))
+        x = conv(self.filters, 3, self.strides, "conv2")(x)
+        x = self.activation_fn(norm("bn2")(x))
+        if self.se_ratio is not None:
+            x = SqueezeExciteBlock(se_ratio=self.se_ratio, dtype=self.dtype)(x)
+        x = conv(self.filters * 4, 1, 1, "conv3")(x)
+        x = norm("bn3", scale_init=nn.initializers.zeros)(x)
+        if residual.shape != x.shape:
+            residual = conv(self.filters * 4, 1, self.strides, "proj_conv")(residual)
+            residual = norm("proj_bn")(residual)
+        return self.activation_fn(x + residual)
+
+
+class BoTBlock(nn.Module):
+    """Bottleneck block with the 3×3 conv replaced by BoTMHSA; stride is a
+    2×2 average pool after attention (botnet.py:202-252)."""
+
+    filters: int
+    num_heads: int = 4
+    strides: int = 1
+    activation_fn: Callable = nn.swish
+    backend: Optional[str] = None
+    dtype: Dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, inputs: jax.Array, is_training: bool) -> jax.Array:
+        norm = lambda name, **kw: nn.BatchNorm(
+            use_running_average=not is_training,
+            momentum=0.9,
+            dtype=self.dtype,
+            name=name,
+            **kw,
+        )
+        conv = lambda feats, k, s, name: nn.Conv(
+            feats, (k, k), strides=(s, s), padding="SAME", use_bias=False,
+            dtype=self.dtype, name=name,
+        )
+        residual = inputs
+        x = conv(self.filters, 1, 1, "conv1")(inputs)
+        x = self.activation_fn(norm("bn1")(x))
+        x = BoTMHSA(
+            num_heads=self.num_heads,
+            head_ch=self.filters // self.num_heads,
+            backend=self.backend,
+            dtype=self.dtype,
+            name="mhsa",
+        )(x)
+        if self.strides == 2:
+            x = nn.avg_pool(x, (2, 2), strides=(2, 2))
+        x = self.activation_fn(norm("bn2")(x))
+        x = conv(self.filters * 4, 1, 1, "conv3")(x)
+        x = norm("bn3", scale_init=nn.initializers.zeros)(x)
+        if residual.shape != x.shape:
+            residual = conv(self.filters * 4, 1, self.strides, "proj_conv")(residual)
+            residual = norm("proj_bn")(residual)
+        return self.activation_fn(x + residual)
+
+
+class BoTNet(nn.Module):
+    num_classes: int
+    stage_sizes: tuple[int, int, int, int] = (3, 4, 6, 6)
+    num_heads: int = 4
+    se_ratio: Optional[float] = 0.25
+    activation_fn: Callable = nn.swish
+    backend: Optional[str] = None
+    dtype: Dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, inputs: jax.Array, is_training: bool) -> jax.Array:
+        x = nn.Conv(
+            64, (7, 7), strides=(2, 2), padding="SAME", use_bias=False,
+            dtype=self.dtype, name="stem_conv",
+        )(inputs)
+        x = nn.BatchNorm(
+            use_running_average=not is_training, momentum=0.9, dtype=self.dtype,
+            name="stem_bn",
+        )(x)
+        x = self.activation_fn(x)
+        x = nn.max_pool(x, (3, 3), strides=(2, 2), padding="SAME")
+
+        filters = (64, 128, 256)
+        for stage in range(3):
+            for block in range(self.stage_sizes[stage]):
+                x = BottleneckResNetBlock(
+                    filters=filters[stage],
+                    strides=2 if stage > 0 and block == 0 else 1,
+                    se_ratio=self.se_ratio,
+                    activation_fn=self.activation_fn,
+                    dtype=self.dtype,
+                    name=f"stage{stage + 1}_block{block}",
+                )(x, is_training)
+        for block in range(self.stage_sizes[3]):
+            x = BoTBlock(
+                filters=512,
+                num_heads=self.num_heads,
+                strides=2 if block == 0 else 1,
+                activation_fn=self.activation_fn,
+                backend=self.backend,
+                dtype=self.dtype,
+                name=f"stage4_block{block}",
+            )(x, is_training)
+
+        x = jnp.mean(x, axis=(1, 2))
+        return nn.Dense(
+            self.num_classes,
+            kernel_init=nn.initializers.zeros,
+            dtype=self.dtype,
+            name="head",
+        )(x)

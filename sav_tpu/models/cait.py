@@ -1,0 +1,166 @@
+"""CaiT — Class-Attention in Image Transformers.
+
+Reference: /root/reference/models/cait.py:10-183. Self-attention trunk with
+talking heads + LayerScale + stochastic depth, followed by class-attention
+blocks that only update a CLS token created *after* the body. The reference's
+missing-dtype bug (cait.py:147-154, SURVEY.md §2.9 #16 — trunk silently ran
+fp32) is fixed: dtype threads through every block.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from sav_tpu.models.layers import (
+    AddAbsPosEmbed,
+    ClassSelfAttentionBlock,
+    FFBlock,
+    LayerScaleBlock,
+    PatchEmbedBlock,
+    SelfAttentionBlock,
+    StochasticDepthBlock,
+)
+
+Dtype = Any
+
+
+class EncoderBlock(nn.Module):
+    """Talking-heads SA + LayerScale + StochasticDepth per branch (cait.py:18-53)."""
+
+    num_heads: int
+    expand_ratio: float = 4.0
+    layerscale_eps: float = 1e-5
+    stoch_depth_rate: float = 0.0
+    attn_dropout_rate: float = 0.0
+    dropout_rate: float = 0.0
+    backend: Optional[str] = None
+    dtype: Dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, inputs: jax.Array, is_training: bool) -> jax.Array:
+        x = nn.LayerNorm(dtype=self.dtype)(inputs)
+        x = SelfAttentionBlock(
+            num_heads=self.num_heads,
+            talking_heads=True,
+            attn_dropout_rate=self.attn_dropout_rate,
+            out_dropout_rate=self.dropout_rate,
+            backend=self.backend,
+            dtype=self.dtype,
+        )(x, is_training)
+        x = LayerScaleBlock(eps=self.layerscale_eps, dtype=self.dtype)(x)
+        x = StochasticDepthBlock(drop_rate=self.stoch_depth_rate)(x, is_training)
+        x = x + inputs
+        y = nn.LayerNorm(dtype=self.dtype)(x)
+        y = FFBlock(
+            expand_ratio=self.expand_ratio,
+            dropout_rate=self.dropout_rate,
+            dtype=self.dtype,
+        )(y, is_training)
+        y = LayerScaleBlock(eps=self.layerscale_eps, dtype=self.dtype)(y)
+        y = StochasticDepthBlock(drop_rate=self.stoch_depth_rate)(y, is_training)
+        return x + y
+
+
+class CAEncoderBlock(nn.Module):
+    """Class-attention block: CLS attends over [CLS; tokens] (cait.py:86-122)."""
+
+    num_heads: int
+    expand_ratio: float = 4.0
+    layerscale_eps: float = 1e-5
+    stoch_depth_rate: float = 0.0
+    attn_dropout_rate: float = 0.0
+    dropout_rate: float = 0.0
+    backend: Optional[str] = None
+    dtype: Dtype = jnp.float32
+
+    @nn.compact
+    def __call__(
+        self, cls_tok: jax.Array, tokens: jax.Array, is_training: bool
+    ) -> jax.Array:
+        concat = jnp.concatenate([cls_tok, tokens], axis=1)
+        x = nn.LayerNorm(dtype=self.dtype)(concat)
+        x = ClassSelfAttentionBlock(
+            num_heads=self.num_heads,
+            attn_dropout_rate=self.attn_dropout_rate,
+            out_dropout_rate=self.dropout_rate,
+            backend=self.backend,
+            dtype=self.dtype,
+        )(x, is_training)
+        x = LayerScaleBlock(eps=self.layerscale_eps, dtype=self.dtype)(x)
+        x = StochasticDepthBlock(drop_rate=self.stoch_depth_rate)(x, is_training)
+        cls_tok = cls_tok + x
+        y = nn.LayerNorm(dtype=self.dtype)(cls_tok)
+        y = FFBlock(
+            expand_ratio=self.expand_ratio,
+            dropout_rate=self.dropout_rate,
+            dtype=self.dtype,
+        )(y, is_training)
+        y = LayerScaleBlock(eps=self.layerscale_eps, dtype=self.dtype)(y)
+        y = StochasticDepthBlock(drop_rate=self.stoch_depth_rate)(y, is_training)
+        return cls_tok + y
+
+
+class CaiT(nn.Module):
+    num_classes: int
+    embed_dim: int
+    num_layers: int
+    num_layers_token_only: int
+    num_heads: int
+    patch_shape: tuple[int, int]
+    expand_ratio: float = 4.0
+    layerscale_eps: float = 1e-5
+    stoch_depth_rate: float = 0.0
+    attn_dropout_rate: float = 0.0
+    dropout_rate: float = 0.0
+    backend: Optional[str] = None
+    dtype: Dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, inputs: jax.Array, is_training: bool) -> jax.Array:
+        x = PatchEmbedBlock(
+            patch_shape=self.patch_shape, embed_dim=self.embed_dim, dtype=self.dtype
+        )(inputs)
+        x = AddAbsPosEmbed(dtype=self.dtype)(x)
+        x = nn.Dropout(rate=self.dropout_rate)(x, deterministic=not is_training)
+        for i in range(self.num_layers):
+            x = EncoderBlock(
+                num_heads=self.num_heads,
+                expand_ratio=self.expand_ratio,
+                layerscale_eps=self.layerscale_eps,
+                stoch_depth_rate=self.stoch_depth_rate,
+                attn_dropout_rate=self.attn_dropout_rate,
+                dropout_rate=self.dropout_rate,
+                backend=self.backend,
+                dtype=self.dtype,
+                name=f"block_{i}",
+            )(x, is_training)
+
+        # CLS token enters only for the class-attention stage (cait.py:157-160).
+        cls_tok = self.param("cls", nn.initializers.zeros, (1, 1, self.embed_dim))
+        cls_tok = jnp.broadcast_to(
+            cls_tok.astype(x.dtype), (x.shape[0], 1, self.embed_dim)
+        )
+        for i in range(self.num_layers_token_only):
+            cls_tok = CAEncoderBlock(
+                num_heads=self.num_heads,
+                expand_ratio=self.expand_ratio,
+                layerscale_eps=self.layerscale_eps,
+                stoch_depth_rate=0.0,  # class-attention stage runs undropped
+                attn_dropout_rate=self.attn_dropout_rate,
+                dropout_rate=self.dropout_rate,
+                backend=self.backend,
+                dtype=self.dtype,
+                name=f"ca_block_{i}",
+            )(cls_tok, x, is_training)
+
+        out = nn.LayerNorm(dtype=self.dtype)(cls_tok[:, 0])
+        return nn.Dense(
+            self.num_classes,
+            kernel_init=nn.initializers.zeros,
+            dtype=self.dtype,
+            name="head",
+        )(out)

@@ -1,0 +1,112 @@
+"""CeiT — Convolution-enhanced image Transformer.
+
+Reference: /root/reference/models/ceit.py:11-156. Image-to-Token conv stem,
+post-norm encoder blocks with LeFF feed-forwards, per-layer CLS collection,
+and a final layer-wise class-attention over the collected CLS tokens. Two
+reference gaps fixed: absolute position embeddings are present (the paper
+uses them; the reference dropped them — SURVEY.md §2.9 #20), and the unused
+``LCAEncoderBlock`` dead code is not reproduced (#17) — the final stage is
+the bare LC attention + FF the reference actually runs.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from sav_tpu.models.layers import (
+    AddAbsPosEmbed,
+    Image2TokenBlock,
+    LCSelfAttentionBlock,
+    LeFFBlock,
+    SelfAttentionBlock,
+)
+
+Dtype = Any
+
+
+class EncoderBlock(nn.Module):
+    """Post-norm block: SA→res→LN, LeFF→res→LN (ceit.py:19-44)."""
+
+    num_heads: int
+    expand_ratio: float = 4.0
+    attn_dropout_rate: float = 0.0
+    dropout_rate: float = 0.0
+    backend: Optional[str] = None
+    dtype: Dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, inputs: jax.Array, is_training: bool) -> jax.Array:
+        x = SelfAttentionBlock(
+            num_heads=self.num_heads,
+            attn_dropout_rate=self.attn_dropout_rate,
+            out_dropout_rate=self.dropout_rate,
+            backend=self.backend,
+            dtype=self.dtype,
+        )(inputs, is_training)
+        x = nn.LayerNorm(dtype=self.dtype)(x + inputs)
+        y = LeFFBlock(expand_ratio=self.expand_ratio, dtype=self.dtype)(x, is_training)
+        return nn.LayerNorm(dtype=self.dtype)(y + x)
+
+
+class CeiT(nn.Module):
+    num_classes: int
+    embed_dim: int
+    num_layers: int
+    num_heads: int
+    patch_shape: tuple[int, int]
+    stem_ch: int = 32
+    expand_ratio: float = 4.0
+    attn_dropout_rate: float = 0.0
+    dropout_rate: float = 0.0
+    backend: Optional[str] = None
+    dtype: Dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, inputs: jax.Array, is_training: bool) -> jax.Array:
+        x = Image2TokenBlock(
+            patch_shape=self.patch_shape,
+            embed_dim=self.embed_dim,
+            stem_ch=self.stem_ch,
+            dtype=self.dtype,
+        )(inputs, is_training)
+        b = x.shape[0]
+        cls_tok = self.param("cls", nn.initializers.zeros, (1, 1, self.embed_dim))
+        cls_tok = jnp.broadcast_to(cls_tok.astype(x.dtype), (b, 1, self.embed_dim))
+        x = jnp.concatenate([cls_tok, x], axis=1)
+        x = AddAbsPosEmbed(dtype=self.dtype)(x)
+        x = nn.Dropout(rate=self.dropout_rate)(x, deterministic=not is_training)
+
+        cls_collection = []
+        for i in range(self.num_layers):
+            x = EncoderBlock(
+                num_heads=self.num_heads,
+                expand_ratio=self.expand_ratio,
+                attn_dropout_rate=self.attn_dropout_rate,
+                dropout_rate=self.dropout_rate,
+                backend=self.backend,
+                dtype=self.dtype,
+                name=f"block_{i}",
+            )(x, is_training)
+            cls_collection.append(x[:, 0])
+
+        # Layer-wise class attention over the L collected CLS tokens; the
+        # query is the final layer's CLS (last token), ceit.py:147-155.
+        cls_seq = jnp.stack(cls_collection, axis=1)  # [B, L_layers, D]
+        out = LCSelfAttentionBlock(
+            num_heads=self.num_heads,
+            attn_dropout_rate=self.attn_dropout_rate,
+            backend=self.backend,
+            dtype=self.dtype,
+            name="lca",
+        )(cls_seq, is_training)
+        out = nn.LayerNorm(dtype=self.dtype)(out[:, -1])
+        return nn.Dense(
+            self.num_classes,
+            kernel_init=nn.initializers.zeros,
+            dtype=self.dtype,
+            name="head",
+        )(out)

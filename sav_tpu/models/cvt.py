@@ -1,0 +1,169 @@
+"""CvT — Convolutional vision Transformer.
+
+Reference: /root/reference/models/cvt.py:10-171. Three stages of strided conv
+token embedding + conv-projection attention blocks; CLS token only in the
+last stage; no position embeddings anywhere (the convs provide locality).
+Reference bugs fixed: blocks are pre-LN as in the paper, and the CLS token is
+carried alongside the grid instead of being zero-padded into it (cvt.py:10-16,
+51-61, 152-164; SURVEY.md §2.9 #19).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from sav_tpu.models.layers import CvTSelfAttentionBlock, FFBlock
+
+Dtype = Any
+
+
+class ConvTokenEmbedBlock(nn.Module):
+    """Strided conv + flatten + LN (cvt.py:19-35)."""
+
+    embed_dim: int
+    kernel_size: tuple[int, int]
+    stride: int
+    dtype: Dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, inputs: jax.Array):
+        x = nn.Conv(
+            features=self.embed_dim,
+            kernel_size=self.kernel_size,
+            strides=(self.stride, self.stride),
+            padding="SAME",
+            dtype=self.dtype,
+            name="proj",
+        )(inputs)
+        b, h, w, c = x.shape
+        tokens = nn.LayerNorm(dtype=self.dtype)(x.reshape(b, h * w, c))
+        return tokens, (h, w)
+
+
+class StageBlock(nn.Module):
+    """Pre-LN: LN→CvT conv-projection SA→res, LN→FF→res."""
+
+    num_heads: int
+    expand_ratio: float = 4.0
+    with_cls: bool = False
+    attn_dropout_rate: float = 0.0
+    dropout_rate: float = 0.0
+    backend: Optional[str] = None
+    dtype: Dtype = jnp.float32
+
+    @nn.compact
+    def __call__(
+        self, tokens: jax.Array, grid_shape: tuple[int, int], is_training: bool
+    ) -> jax.Array:
+        x = nn.LayerNorm(dtype=self.dtype)(tokens)
+        x = CvTSelfAttentionBlock(
+            num_heads=self.num_heads,
+            with_cls=self.with_cls,
+            attn_dropout_rate=self.attn_dropout_rate,
+            out_dropout_rate=self.dropout_rate,
+            backend=self.backend,
+            dtype=self.dtype,
+        )(x, grid_shape, is_training)
+        tokens = tokens + x
+        y = nn.LayerNorm(dtype=self.dtype)(tokens)
+        y = FFBlock(
+            expand_ratio=self.expand_ratio,
+            dropout_rate=self.dropout_rate,
+            dtype=self.dtype,
+        )(y, is_training)
+        return tokens + y
+
+
+class Stage(nn.Module):
+    """Token embed (+ optional CLS) then N stage blocks (cvt.py:71-113)."""
+
+    embed_dim: int
+    num_layers: int
+    num_heads: int
+    kernel_size: tuple[int, int]
+    stride: int
+    expand_ratio: float = 4.0
+    insert_cls: bool = False
+    attn_dropout_rate: float = 0.0
+    dropout_rate: float = 0.0
+    backend: Optional[str] = None
+    dtype: Dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, inputs: jax.Array, is_training: bool):
+        tokens, grid_shape = ConvTokenEmbedBlock(
+            embed_dim=self.embed_dim,
+            kernel_size=self.kernel_size,
+            stride=self.stride,
+            dtype=self.dtype,
+        )(inputs)
+        if self.insert_cls:
+            cls_tok = self.param("cls", nn.initializers.zeros, (1, 1, self.embed_dim))
+            cls_tok = jnp.broadcast_to(
+                cls_tok.astype(tokens.dtype), (tokens.shape[0], 1, self.embed_dim)
+            )
+            tokens = jnp.concatenate([cls_tok, tokens], axis=1)
+        for i in range(self.num_layers):
+            tokens = StageBlock(
+                num_heads=self.num_heads,
+                expand_ratio=self.expand_ratio,
+                with_cls=self.insert_cls,
+                attn_dropout_rate=self.attn_dropout_rate,
+                dropout_rate=self.dropout_rate,
+                backend=self.backend,
+                dtype=self.dtype,
+                name=f"block_{i}",
+            )(tokens, grid_shape, is_training)
+        return tokens, grid_shape
+
+
+class CvT(nn.Module):
+    num_classes: int
+    embed_dims: tuple[int, int, int] = (64, 192, 384)
+    num_layers: tuple[int, int, int] = (1, 2, 10)
+    num_heads: tuple[int, int, int] = (1, 3, 6)
+    strides: tuple[int, int, int] = (4, 2, 2)
+    kernel_sizes: tuple = ((7, 7), (3, 3), (3, 3))
+    expand_ratio: float = 4.0
+    attn_dropout_rate: float = 0.0
+    dropout_rate: float = 0.0
+    backend: Optional[str] = None
+    dtype: Dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, inputs: jax.Array, is_training: bool) -> jax.Array:
+        x = inputs
+        tokens = None
+        for s in range(3):
+            last = s == 2
+            tokens, grid_shape = Stage(
+                embed_dim=self.embed_dims[s],
+                num_layers=self.num_layers[s],
+                num_heads=self.num_heads[s],
+                kernel_size=self.kernel_sizes[s],
+                stride=self.strides[s],
+                expand_ratio=self.expand_ratio,
+                insert_cls=last,
+                attn_dropout_rate=self.attn_dropout_rate,
+                dropout_rate=self.dropout_rate,
+                backend=self.backend,
+                dtype=self.dtype,
+                name=f"stage_{s}",
+            )(x, is_training)
+            if not last:
+                # Re-grid tokens for the next stage's conv embed (cvt.py:148-150).
+                b = tokens.shape[0]
+                h, w = grid_shape
+                x = tokens.reshape(b, h, w, self.embed_dims[s])
+
+        out = nn.LayerNorm(dtype=self.dtype)(tokens[:, 0])
+        return nn.Dense(
+            self.num_classes,
+            kernel_init=nn.initializers.zeros,
+            dtype=self.dtype,
+            name="head",
+        )(out)

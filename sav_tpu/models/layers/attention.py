@@ -1,0 +1,157 @@
+"""Canonical multi-head attention blocks.
+
+Capability parity with the reference's attention family
+(/root/reference/models/layers/attentions/attention.py:10-74,
+talking_heads.py:5-14), redesigned around the backend-dispatched functional
+cores in :mod:`sav_tpu.ops.attention` so every block can run on the fused
+Pallas TPU kernel (``backend='pallas'``) or the XLA reference path
+(``backend='xla'``). Talking-heads mixing happens on the logits, which breaks
+per-head independence inside the fused kernel — that variant always runs the
+XLA path (CaiT's self-attention trunk).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from sav_tpu.ops.attention import dot_product_attention
+
+Dtype = Any
+
+
+class TalkingHeadsBlock(nn.Module):
+    """Learned head-mixing transform (orthogonal init), applied to attention
+    logits or probabilities. Reference: talking_heads.py:5-14."""
+
+    num_heads: int
+    dtype: Dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x: jax.Array) -> jax.Array:
+        kernel = self.param(
+            "kernel", nn.initializers.orthogonal(), (self.num_heads, self.num_heads)
+        )
+        return jnp.einsum("hi,...hqk->...iqk", kernel.astype(x.dtype), x)
+
+
+def talking_heads_attention(
+    query: jax.Array,
+    key: jax.Array,
+    value: jax.Array,
+    *,
+    num_heads: int,
+    scale: float,
+    attn_dropout_rate: float,
+    is_training: bool,
+    dtype: Dtype,
+) -> jax.Array:
+    """Attention core with pre/post-softmax head mixing (XLA path).
+
+    Must be called from within a parent module's ``@nn.compact`` ``__call__``
+    — it instantiates the two ``TalkingHeadsBlock`` submodules (named
+    ``pre_softmax`` / ``post_softmax``) on the caller's scope. Shared by
+    ``AttentionBlock`` and ``CvTAttentionBlock``.
+    """
+    logits = jnp.einsum(
+        "...qhd,...khd->...hqk",
+        query * jnp.asarray(scale, query.dtype),
+        key,
+        preferred_element_type=jnp.float32,
+    )
+    logits = TalkingHeadsBlock(num_heads=num_heads, dtype=dtype, name="pre_softmax")(
+        logits
+    )
+    probs = jax.nn.softmax(logits, axis=-1)
+    probs = TalkingHeadsBlock(num_heads=num_heads, dtype=dtype, name="post_softmax")(
+        probs
+    )
+    probs = nn.Dropout(rate=attn_dropout_rate)(probs, deterministic=not is_training)
+    return jnp.einsum("...hqk,...khd->...qhd", probs.astype(value.dtype), value)
+
+
+class AttentionBlock(nn.Module):
+    """Multi-head (cross-)attention with optional talking heads.
+
+    Reference: attention.py:10-67. Q/K/V are ``nn.DenseGeneral`` projections
+    to ``(num_heads, head_ch)``; logits scale is ``head_ch ** -0.5``; output
+    merge is a ``DenseGeneral`` over ``(heads, head_ch)``.
+    """
+
+    num_heads: int
+    head_ch: Optional[int] = None
+    out_ch: Optional[int] = None
+    talking_heads: bool = False
+    attn_dropout_rate: float = 0.0
+    out_dropout_rate: float = 0.0
+    use_bias: bool = False
+    backend: Optional[str] = None  # None/'auto' | 'xla' | 'pallas'
+    dtype: Dtype = jnp.float32
+
+    @nn.compact
+    def __call__(
+        self, inputs_q: jax.Array, inputs_kv: jax.Array, is_training: bool
+    ) -> jax.Array:
+        in_ch = inputs_q.shape[-1]
+        head_ch = self.head_ch or in_ch // self.num_heads
+        out_ch = self.out_ch or in_ch
+        scale = head_ch**-0.5
+
+        dense = functools.partial(
+            nn.DenseGeneral,
+            features=(self.num_heads, head_ch),
+            axis=-1,
+            use_bias=self.use_bias,
+            dtype=self.dtype,
+        )
+        query = dense(name="to_q")(inputs_q)
+        key = dense(name="to_k")(inputs_kv)
+        value = dense(name="to_v")(inputs_kv)
+
+        has_attn_dropout = self.attn_dropout_rate > 0.0 and is_training
+        if self.talking_heads:
+            # Head mixing couples heads pre-softmax → XLA path.
+            out = talking_heads_attention(
+                query,
+                key,
+                value,
+                num_heads=self.num_heads,
+                scale=scale,
+                attn_dropout_rate=self.attn_dropout_rate,
+                is_training=is_training,
+                dtype=self.dtype,
+            )
+        else:
+            dropout_rng = self.make_rng("dropout") if has_attn_dropout else None
+            out = dot_product_attention(
+                query,
+                key,
+                value,
+                scale=scale,
+                dropout_rate=self.attn_dropout_rate,
+                dropout_rng=dropout_rng,
+                deterministic=not is_training,
+                backend=self.backend,
+            )
+
+        out = nn.DenseGeneral(
+            features=out_ch,
+            axis=(-2, -1),
+            use_bias=self.use_bias,
+            dtype=self.dtype,
+            name="to_out",
+        )(out)
+        out = nn.Dropout(rate=self.out_dropout_rate)(out, deterministic=not is_training)
+        return out
+
+
+class SelfAttentionBlock(AttentionBlock):
+    """Self-attention specialization (attention.py:70-74)."""
+
+    @nn.compact
+    def __call__(self, inputs: jax.Array, is_training: bool) -> jax.Array:  # type: ignore[override]
+        return super().__call__(inputs, inputs, is_training)

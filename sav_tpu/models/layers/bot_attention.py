@@ -1,0 +1,80 @@
+"""BoTNet 2-D relative-position multi-head self-attention.
+
+Fixed, working rebuild of the reference's ``BoTMHSA`` + ``RelativeLogits``
+(/root/reference/models/botnet.py:70-199 — the original crashes on
+``self.head_dim``/``self.config`` and contracts the wrong axes in its output
+einsum; SURVEY.md §2.9 #1-3). Design: the learned relative tables produce an
+additive logits bias via :func:`sav_tpu.ops.relative.relative_logits_2d`, and
+the attention core is the shared ``dot_product_attention`` — so on the Pallas
+path the relative logits enter the fused flash kernel as a bias and the
+``[B, heads, HW, HW]`` softmax never round-trips HBM.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from sav_tpu.ops.attention import dot_product_attention
+from sav_tpu.ops.relative import relative_logits_2d
+
+Dtype = Any
+
+
+class BoTMHSA(nn.Module):
+    """All-2-D self-attention on a ``[B, H, W, C]`` feature map.
+
+    Returns ``[B, H, W, num_heads * head_ch]`` (no output projection — the
+    surrounding bottleneck's 1×1 convs do channel mixing, botnet.py:202-252).
+    """
+
+    num_heads: int
+    head_ch: Optional[int] = None
+    pos_emb_init_stddev: Optional[float] = None
+    backend: Optional[str] = None
+    dtype: Dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, inputs: jax.Array) -> jax.Array:
+        b, height, width, ch = inputs.shape
+        head_ch = self.head_ch or ch // self.num_heads
+        inner = self.num_heads * head_ch
+        scale = head_ch**-0.5
+
+        dense = lambda name: nn.DenseGeneral(
+            features=(self.num_heads, head_ch),
+            axis=-1,
+            use_bias=False,
+            dtype=self.dtype,
+            name=name,
+        )
+        tokens = inputs.reshape(b, height * width, ch)
+        query = dense("to_q")(tokens)  # [B, HW, h, d]
+        key = dense("to_k")(tokens)
+        value = dense("to_v")(tokens)
+
+        stddev = self.pos_emb_init_stddev or head_ch**-0.5
+        rel_k_h = self.param(
+            "rel_emb_h", nn.initializers.normal(stddev=stddev), (2 * height - 1, head_ch)
+        )
+        rel_k_w = self.param(
+            "rel_emb_w", nn.initializers.normal(stddev=stddev), (2 * width - 1, head_ch)
+        )
+
+        # Relative logits use the same scaled query as the content logits.
+        q_grid = jnp.transpose(
+            query.reshape(b, height, width, self.num_heads, head_ch), (0, 3, 1, 2, 4)
+        )
+        q_grid = q_grid * jnp.asarray(scale, q_grid.dtype)
+        bias = relative_logits_2d(
+            q_grid, rel_k_h.astype(q_grid.dtype), rel_k_w.astype(q_grid.dtype)
+        )
+        bias = bias.reshape(b, self.num_heads, height * width, height * width)
+
+        out = dot_product_attention(
+            query, key, value, bias=bias, scale=scale, backend=self.backend
+        )
+        return out.reshape(b, height, width, inner)

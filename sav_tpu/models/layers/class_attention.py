@@ -1,0 +1,28 @@
+"""Single-query class-attention blocks (CaiT / CeiT).
+
+Reference: CaiT ``ClassSelfAttentionBlock`` (/root/reference/models/cait.py:10-15,
+query = token 0) and CeiT ``LCSelfAttentionBlock`` (/root/reference/models/ceit.py:11-16,
+query = last token). Both are O(L): one query row attending over the full
+sequence. On the Pallas path the single query row rides the fused kernel with
+a (padded) minimal q block.
+"""
+
+from __future__ import annotations
+
+import jax
+
+from sav_tpu.models.layers.attention import AttentionBlock
+
+
+class ClassSelfAttentionBlock(AttentionBlock):
+    """Query is the first (CLS) token only; K/V span the full sequence."""
+
+    def __call__(self, inputs: jax.Array, is_training: bool) -> jax.Array:  # type: ignore[override]
+        return super().__call__(inputs[:, 0:1], inputs, is_training)
+
+
+class LCSelfAttentionBlock(AttentionBlock):
+    """Query is the last token only (CeiT layer-wise class attention)."""
+
+    def __call__(self, inputs: jax.Array, is_training: bool) -> jax.Array:  # type: ignore[override]
+        return super().__call__(inputs[:, -1:], inputs, is_training)

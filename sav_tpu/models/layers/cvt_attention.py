@@ -1,0 +1,159 @@
+"""CvT conv-projection attention.
+
+Reference: /root/reference/models/layers/attentions/cvt_attention.py:12-120.
+Q/K/V projections are depthwise 3×3 conv + BatchNorm followed by a pointwise
+projection to ``(heads, head_ch)``, with per-projection strides (default
+``(1, 2, 2)`` → K/V grids downsampled 2×). Unlike the reference (which takes
+a 4-D feature map and cannot carry a CLS token correctly — SURVEY.md §2.9
+#19), this block takes a token sequence plus its grid shape and handles an
+optional leading CLS token the paper's way: the CLS token skips the depthwise
+conv and joins the sequence for the pointwise head projection.
+
+The attention core itself is the shared backend-dispatched
+``dot_product_attention`` → the fused Pallas kernel applies to CvT as well;
+only the conv projections stay in XLA (convs already map optimally to the
+MXU).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from sav_tpu.models.layers.attention import talking_heads_attention
+from sav_tpu.ops.attention import dot_product_attention
+
+Dtype = Any
+
+
+class ConvProjectionBlock(nn.Module):
+    """Depthwise 3×3 conv + BN on the token grid, then pointwise head projection.
+
+    Returns head-split tokens ``[B, L', heads, head_ch]``.
+    """
+
+    num_heads: int
+    head_ch: int
+    kernel_size: tuple[int, int] = (3, 3)
+    stride: int = 1
+    use_bias: bool = False
+    with_cls: bool = False
+    dtype: Dtype = jnp.float32
+
+    @nn.compact
+    def __call__(
+        self, tokens: jax.Array, grid_shape: tuple[int, int], is_training: bool
+    ) -> jax.Array:
+        b = tokens.shape[0]
+        h, w = grid_shape
+        ch = tokens.shape[-1]
+        if self.with_cls:
+            cls_tok, grid_tokens = tokens[:, :1], tokens[:, 1:]
+        else:
+            cls_tok, grid_tokens = None, tokens
+        x = grid_tokens.reshape(b, h, w, ch)
+        x = nn.Conv(
+            features=ch,
+            kernel_size=self.kernel_size,
+            strides=(self.stride, self.stride),
+            padding="SAME",
+            feature_group_count=ch,
+            use_bias=False,
+            dtype=self.dtype,
+            name="depthwise",
+        )(x)
+        x = nn.BatchNorm(
+            use_running_average=not is_training, momentum=0.9, dtype=self.dtype, name="bn"
+        )(x)
+        x = x.reshape(b, -1, ch)
+        if cls_tok is not None:
+            x = jnp.concatenate([cls_tok, x], axis=1)
+        return nn.DenseGeneral(
+            features=(self.num_heads, self.head_ch),
+            axis=-1,
+            use_bias=self.use_bias,
+            dtype=self.dtype,
+            name="pointwise",
+        )(x)
+
+
+class CvTAttentionBlock(nn.Module):
+    """Attention over a token grid with conv Q/K/V projections."""
+
+    num_heads: int
+    head_ch: Optional[int] = None
+    out_ch: Optional[int] = None
+    strides: tuple[int, int, int] = (1, 2, 2)  # (q, k, v)
+    talking_heads: bool = False
+    attn_dropout_rate: float = 0.0
+    out_dropout_rate: float = 0.0
+    use_bias: bool = False
+    with_cls: bool = False
+    backend: Optional[str] = None
+    dtype: Dtype = jnp.float32
+
+    @nn.compact
+    def __call__(
+        self, inputs: jax.Array, grid_shape: tuple[int, int], is_training: bool
+    ) -> jax.Array:
+        in_ch = inputs.shape[-1]
+        head_ch = self.head_ch or in_ch // self.num_heads
+        out_ch = self.out_ch or in_ch
+        scale = head_ch**-0.5
+
+        proj = functools.partial(
+            ConvProjectionBlock,
+            num_heads=self.num_heads,
+            head_ch=head_ch,
+            use_bias=self.use_bias,
+            with_cls=self.with_cls,
+            dtype=self.dtype,
+        )
+        sq, sk, sv = self.strides
+        query = proj(stride=sq, name="to_q")(inputs, grid_shape, is_training)
+        key = proj(stride=sk, name="to_k")(inputs, grid_shape, is_training)
+        value = proj(stride=sv, name="to_v")(inputs, grid_shape, is_training)
+
+        has_attn_dropout = self.attn_dropout_rate > 0.0 and is_training
+        if self.talking_heads:
+            out = talking_heads_attention(
+                query,
+                key,
+                value,
+                num_heads=self.num_heads,
+                scale=scale,
+                attn_dropout_rate=self.attn_dropout_rate,
+                is_training=is_training,
+                dtype=self.dtype,
+            )
+        else:
+            dropout_rng = self.make_rng("dropout") if has_attn_dropout else None
+            out = dot_product_attention(
+                query,
+                key,
+                value,
+                scale=scale,
+                dropout_rate=self.attn_dropout_rate,
+                dropout_rng=dropout_rng,
+                deterministic=not is_training,
+                backend=self.backend,
+            )
+
+        out = nn.DenseGeneral(
+            features=out_ch,
+            axis=(-2, -1),
+            use_bias=self.use_bias,
+            dtype=self.dtype,
+            name="to_out",
+        )(out)
+        out = nn.Dropout(rate=self.out_dropout_rate)(out, deterministic=not is_training)
+        return out
+
+
+class CvTSelfAttentionBlock(CvTAttentionBlock):
+    """Alias kept for reference API parity (cvt_attention.py:116-120); the
+    block is already self-attention over its token grid."""

@@ -1,0 +1,83 @@
+"""Feed-forward blocks: transformer MLP and CeiT's locally-enhanced FF.
+
+Reference: FFBlock (/root/reference/models/layers/feedforwards/ff.py:8-34),
+LeFFBlock (/root/reference/models/layers/feedforwards/leff.py:9-63).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+Dtype = Any
+
+
+class FFBlock(nn.Module):
+    """Dense(expand) → act → dropout → Dense(in_ch) → dropout."""
+
+    expand_ratio: Optional[float] = 4.0
+    hidden_ch: Optional[int] = None
+    dropout_rate: float = 0.0
+    activation_fn: Callable = nn.gelu
+    use_bias: bool = True
+    dtype: Dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, inputs: jax.Array, is_training: bool) -> jax.Array:
+        in_ch = inputs.shape[-1]
+        hidden = self.hidden_ch or int(in_ch * self.expand_ratio)
+        x = nn.Dense(hidden, use_bias=self.use_bias, dtype=self.dtype, name="fc1")(inputs)
+        x = self.activation_fn(x)
+        x = nn.Dropout(rate=self.dropout_rate)(x, deterministic=not is_training)
+        x = nn.Dense(in_ch, use_bias=self.use_bias, dtype=self.dtype, name="fc2")(x)
+        x = nn.Dropout(rate=self.dropout_rate)(x, deterministic=not is_training)
+        return x
+
+
+class LeFFBlock(nn.Module):
+    """CeiT locally-enhanced feed-forward.
+
+    Splits the CLS token off, expands patch tokens, re-grids them to √L×√L,
+    applies a depthwise conv (default 5×5), projects back, and re-concats the
+    CLS token. BatchNorm after each stage as in the reference (leff.py:39-59).
+    """
+
+    expand_ratio: Optional[float] = 4.0
+    hidden_ch: Optional[int] = None
+    kernel_size: tuple[int, int] = (5, 5)
+    activation_fn: Callable = nn.gelu
+    dtype: Dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, inputs: jax.Array, is_training: bool) -> jax.Array:
+        in_ch = inputs.shape[-1]
+        hidden = self.hidden_ch or int(in_ch * self.expand_ratio)
+        cls_tok, tokens = inputs[:, :1], inputs[:, 1:]
+        b, l, _ = tokens.shape
+        side = int(round(l**0.5))
+        if side * side != l:
+            raise ValueError(f"LeFF requires a square token grid, got {l} tokens")
+
+        norm = lambda name: nn.BatchNorm(
+            use_running_average=not is_training, momentum=0.9, dtype=self.dtype, name=name
+        )
+        x = nn.Dense(hidden, dtype=self.dtype, name="expand")(tokens)
+        x = self.activation_fn(norm("bn1")(x))
+        x = x.reshape(b, side, side, hidden)
+        x = nn.Conv(
+            features=hidden,
+            kernel_size=self.kernel_size,
+            padding="SAME",
+            feature_group_count=hidden,
+            use_bias=False,
+            dtype=self.dtype,
+            name="dwconv",
+        )(x)
+        x = self.activation_fn(norm("bn2")(x))
+        x = x.reshape(b, l, hidden)
+        x = nn.Dense(in_ch, dtype=self.dtype, name="project")(x)
+        x = self.activation_fn(norm("bn3")(x))
+        return jnp.concatenate([cls_tok, x], axis=1)

@@ -1,0 +1,24 @@
+"""LayerScale (CaiT). Reference: /root/reference/models/layers/normalizations/layerscale.py:5-23."""
+
+from __future__ import annotations
+
+from typing import Any
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+Dtype = Any
+
+
+class LayerScaleBlock(nn.Module):
+    """Per-channel learned scale on a residual branch, initialized to ``eps``."""
+
+    eps: float = 1e-4
+    dtype: Dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, inputs: jax.Array) -> jax.Array:
+        dim = inputs.shape[-1]
+        scale = self.param("scale", nn.initializers.constant(self.eps), (dim,))
+        return inputs * scale.astype(inputs.dtype)

@@ -1,0 +1,59 @@
+"""Position embedding layers.
+
+Reference: /root/reference/models/layers/position_embed.py:8-57. The fixed
+sinusoidal + rotary paths there were broken and never wired in (SURVEY.md
+§2.9 #12); here they are working modules over :mod:`sav_tpu.ops.rotary`.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from sav_tpu.ops.rotary import apply_rotary_pos_emb, fixed_positional_embedding
+
+Dtype = Any
+
+
+class AddAbsPosEmbed(nn.Module):
+    """Learned absolute position table ``(1, L, D)``, normal(0.02) init."""
+
+    init_stddev: float = 0.02
+    dtype: Dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, inputs: jax.Array) -> jax.Array:
+        _, length, dim = inputs.shape
+        table = self.param(
+            "pos_embed",
+            nn.initializers.normal(stddev=self.init_stddev),
+            (1, length, dim),
+        )
+        return inputs + table.astype(inputs.dtype)
+
+
+class FixedPositionalEmbedding(nn.Module):
+    """Adds a (non-learned) sinusoidal position embedding."""
+
+    dtype: Dtype = jnp.float32
+
+    def __call__(self, inputs: jax.Array) -> jax.Array:
+        _, length, dim = inputs.shape
+        sin, cos = fixed_positional_embedding(length, dim, dtype=jnp.float32)
+        # Interleave: even channels get sin, odd get cos.
+        table = jnp.where(jnp.arange(dim) % 2 == 0, sin, cos)
+        return inputs + table[None].astype(inputs.dtype)
+
+
+class RotaryPositionalEmbedding(nn.Module):
+    """Applies RoPE to a token sequence ``[B, L, D]`` or per-head ``[B, L, H, D]``."""
+
+    dtype: Dtype = jnp.float32
+
+    def __call__(self, inputs: jax.Array) -> jax.Array:
+        length, dim = inputs.shape[1], inputs.shape[-1]
+        sincos = fixed_positional_embedding(length, dim, dtype=jnp.float32)
+        return apply_rotary_pos_emb(inputs, sincos)

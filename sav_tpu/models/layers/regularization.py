@@ -1,0 +1,29 @@
+"""Stochastic depth (per-sample residual drop).
+
+Reference: /root/reference/models/layers/regularization/stochastic_depth.py:6-28,
+with the ``scale_by_keep=False`` crash fixed (SURVEY.md §2.9 #5). Uses its own
+``'stochastic_depth'`` RNG stream as the reference does.
+"""
+
+from __future__ import annotations
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+
+class StochasticDepthBlock(nn.Module):
+    drop_rate: float = 0.0
+    scale_by_keep: bool = True
+
+    @nn.compact
+    def __call__(self, inputs: jax.Array, is_training: bool) -> jax.Array:
+        if not is_training or self.drop_rate == 0.0:
+            return inputs
+        keep_prob = 1.0 - self.drop_rate
+        rng = self.make_rng("stochastic_depth")
+        mask_shape = (inputs.shape[0],) + (1,) * (inputs.ndim - 1)
+        mask = jax.random.bernoulli(rng, keep_prob, mask_shape).astype(inputs.dtype)
+        if self.scale_by_keep:
+            mask = mask / keep_prob
+        return inputs * mask

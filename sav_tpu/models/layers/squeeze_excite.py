@@ -1,0 +1,33 @@
+"""Squeeze-and-excitation gate.
+
+Reference: /root/reference/models/layers/squeeze_excite.py:13-38, with the
+pooled-array-call crash fixed (SURVEY.md §2.9 #4) so BoTNet's bottleneck
+blocks can actually use it.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+Dtype = Any
+
+
+class SqueezeExciteBlock(nn.Module):
+    se_ratio: float = 0.25
+    activation_fn: Callable = nn.swish
+    dtype: Dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, inputs: jax.Array) -> jax.Array:
+        ch = inputs.shape[-1]
+        hidden = max(1, int(ch * self.se_ratio))
+        gate = jnp.mean(inputs, axis=(1, 2))  # [B, C] global average pool
+        gate = nn.Dense(hidden, dtype=self.dtype, name="reduce")(gate)
+        gate = self.activation_fn(gate)
+        gate = nn.Dense(ch, dtype=self.dtype, name="expand")(gate)
+        gate = nn.sigmoid(gate)
+        return inputs * gate[:, None, None, :]

@@ -1,0 +1,82 @@
+"""Patch/conv tokenization stems.
+
+Reference: PatchEmbedBlock (/root/reference/models/layers/stems/patch_embed.py:8-26),
+Image2TokenBlock (/root/reference/models/layers/stems/image_to_token.py:8-48).
+
+PatchEmbedBlock here uses a strided conv instead of the reference's
+rearrange+Dense — mathematically identical, but a conv maps straight onto the
+MXU with good layouts and lets XLA pick the im2col strategy.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+Dtype = Any
+
+
+class PatchEmbedBlock(nn.Module):
+    """Non-overlapping patch embedding: ``[B,H,W,C] → [B, (H/ph)(W/pw), D]``."""
+
+    patch_shape: tuple[int, int]
+    embed_dim: int
+    use_bias: bool = True
+    dtype: Dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, inputs: jax.Array) -> jax.Array:
+        ph, pw = self.patch_shape
+        b, h, w, _ = inputs.shape
+        if h % ph or w % pw:
+            raise ValueError(f"image {h}x{w} not divisible by patch {self.patch_shape}")
+        x = nn.Conv(
+            features=self.embed_dim,
+            kernel_size=(ph, pw),
+            strides=(ph, pw),
+            padding="VALID",
+            use_bias=self.use_bias,
+            dtype=self.dtype,
+            name="proj",
+        )(inputs)
+        return x.reshape(b, (h // ph) * (w // pw), self.embed_dim)
+
+
+class Image2TokenBlock(nn.Module):
+    """CeiT conv stem: 7×7/s2 conv + BN + 3×3/s2 max-pool, then patchify+embed."""
+
+    patch_shape: tuple[int, int]
+    embed_dim: int
+    stem_ch: int = 32
+    conv_kernel: tuple[int, int] = (7, 7)
+    conv_stride: tuple[int, int] = (2, 2)
+    pool_window: tuple[int, int] = (3, 3)
+    pool_stride: tuple[int, int] = (2, 2)
+    use_bias: bool = True
+    dtype: Dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, inputs: jax.Array, is_training: bool) -> jax.Array:
+        x = nn.Conv(
+            features=self.stem_ch,
+            kernel_size=self.conv_kernel,
+            strides=self.conv_stride,
+            padding="SAME",
+            use_bias=False,
+            dtype=self.dtype,
+            name="stem_conv",
+        )(inputs)
+        x = nn.BatchNorm(
+            use_running_average=not is_training, momentum=0.9, dtype=self.dtype, name="stem_bn"
+        )(x)
+        x = nn.max_pool(x, self.pool_window, strides=self.pool_stride, padding="SAME")
+        return PatchEmbedBlock(
+            patch_shape=self.patch_shape,
+            embed_dim=self.embed_dim,
+            use_bias=self.use_bias,
+            dtype=self.dtype,
+            name="patch_embed",
+        )(x)

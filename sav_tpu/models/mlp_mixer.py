@@ -1,0 +1,76 @@
+"""MLP-Mixer. Reference: /root/reference/models/mlp_mixer.py:10-60."""
+
+from __future__ import annotations
+
+from typing import Any
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from sav_tpu.models.layers import FFBlock, PatchEmbedBlock
+
+Dtype = Any
+
+
+class MixerBlock(nn.Module):
+    """Token-mixing MLP (on transposed tokens) + channel-mixing MLP."""
+
+    tokens_hidden_ch: int
+    channels_hidden_ch: int
+    dropout_rate: float = 0.0
+    dtype: Dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, inputs: jax.Array, is_training: bool) -> jax.Array:
+        x = nn.LayerNorm(dtype=self.dtype)(inputs)
+        x = jnp.swapaxes(x, -1, -2)  # [B, D, L]
+        x = FFBlock(
+            hidden_ch=self.tokens_hidden_ch,
+            dropout_rate=self.dropout_rate,
+            dtype=self.dtype,
+            name="token_mixing",
+        )(x, is_training)
+        x = jnp.swapaxes(x, -1, -2)
+        x = x + inputs
+        y = nn.LayerNorm(dtype=self.dtype)(x)
+        y = FFBlock(
+            hidden_ch=self.channels_hidden_ch,
+            dropout_rate=self.dropout_rate,
+            dtype=self.dtype,
+            name="channel_mixing",
+        )(y, is_training)
+        return x + y
+
+
+class MLPMixer(nn.Module):
+    num_classes: int
+    embed_dim: int
+    num_layers: int
+    tokens_hidden_ch: int
+    channels_hidden_ch: int
+    patch_shape: tuple[int, int]
+    dropout_rate: float = 0.0
+    dtype: Dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, inputs: jax.Array, is_training: bool) -> jax.Array:
+        x = PatchEmbedBlock(
+            patch_shape=self.patch_shape, embed_dim=self.embed_dim, dtype=self.dtype
+        )(inputs)
+        for i in range(self.num_layers):
+            x = MixerBlock(
+                tokens_hidden_ch=self.tokens_hidden_ch,
+                channels_hidden_ch=self.channels_hidden_ch,
+                dropout_rate=self.dropout_rate,
+                dtype=self.dtype,
+                name=f"block_{i}",
+            )(x, is_training)
+        x = nn.LayerNorm(dtype=self.dtype)(x)
+        x = jnp.mean(x, axis=1)
+        return nn.Dense(
+            self.num_classes,
+            kernel_init=nn.initializers.zeros,
+            dtype=self.dtype,
+            name="head",
+        )(x)

@@ -1,0 +1,169 @@
+"""Named model registry.
+
+Replaces the reference's if/elif factory (/root/reference/models/create_model.py:6-215)
+with a declarative dict. All 31 reference config names resolve here, with the
+reference's config bugs fixed against the papers (SURVEY.md §2.9):
+  - #13 TNT-S/TNT-B hyperparameters un-swapped,
+  - #14 CvT embed dim 384 (not 368),
+  - #15 duplicate ``mixer_s_patch32`` key → ``mixer_b_patch16``; Mixer-L has
+    24 layers.
+Extra names beyond reference parity: ``vit_s_patch16`` / ``deit_s_patch16``
+(the BASELINE.json north-star benchmark model) and ``vit_ti_patch16``
+(the CPU-runnable smoke config).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Optional
+
+import jax.numpy as jnp
+
+from sav_tpu.models.botnet import BoTNet
+from sav_tpu.models.cait import CaiT
+from sav_tpu.models.ceit import CeiT
+from sav_tpu.models.cvt import CvT
+from sav_tpu.models.mlp_mixer import MLPMixer
+from sav_tpu.models.tnt import TNT
+from sav_tpu.models.vit import ViT
+
+_REGISTRY: dict[str, tuple[type, dict[str, Any]]] = {}
+
+
+def register(name: str, cls: type, **kwargs):
+    _REGISTRY[name] = (cls, kwargs)
+
+
+def _vit(embed_dim, num_layers, num_heads, patch):
+    return dict(
+        embed_dim=embed_dim,
+        num_layers=num_layers,
+        num_heads=num_heads,
+        patch_shape=(patch, patch),
+    )
+
+
+# --- ViT family (create_model.py:10-37 + north-star extras) -----------------
+register("vit_ti_patch16", ViT, **_vit(192, 12, 3, 16))
+register("vit_s_patch32", ViT, **_vit(384, 12, 6, 32))
+register("vit_s_patch16", ViT, **_vit(384, 12, 6, 16))
+register("deit_s_patch16", ViT, **_vit(384, 12, 6, 16))
+register("vit_b_patch32", ViT, **_vit(768, 12, 12, 32))
+register("vit_b_patch16", ViT, **_vit(768, 12, 12, 16))
+register("vit_l_patch32", ViT, **_vit(1024, 24, 16, 32))
+register("vit_l_patch16", ViT, **_vit(1024, 24, 16, 16))
+
+# --- BoTNet (create_model.py:38-49) ----------------------------------------
+register("botnet_t3", BoTNet, stage_sizes=(3, 4, 6, 6))
+register("botnet_t4", BoTNet, stage_sizes=(3, 4, 23, 6))
+register("botnet_t5", BoTNet, stage_sizes=(3, 4, 23, 12))
+
+# --- TNT (create_model.py:50-63; S/B fixed per paper & tnt_test.py:14-15) ---
+register(
+    "tnt_s_patch16",
+    TNT,
+    embed_dim=384, inner_ch=24, num_layers=12, num_heads=6, inner_num_heads=4,
+    patch_shape=(16, 16),
+)
+register(
+    "tnt_b_patch16",
+    TNT,
+    embed_dim=640, inner_ch=40, num_layers=12, num_heads=10, inner_num_heads=4,
+    patch_shape=(16, 16),
+)
+
+# --- CeiT (create_model.py:64-78) ------------------------------------------
+register("ceit_t", CeiT, embed_dim=192, num_layers=12, num_heads=3, patch_shape=(4, 4))
+register("ceit_s", CeiT, embed_dim=384, num_layers=12, num_heads=6, patch_shape=(4, 4))
+register("ceit_b", CeiT, embed_dim=768, num_layers=12, num_heads=12, patch_shape=(4, 4))
+
+
+# --- CaiT (create_model.py:79-168) -----------------------------------------
+def _cait(embed_dim, num_layers, num_heads, stoch_depth_rate, layerscale_eps):
+    return dict(
+        embed_dim=embed_dim,
+        num_layers=num_layers,
+        num_layers_token_only=2,
+        num_heads=num_heads,
+        patch_shape=(16, 16),
+        stoch_depth_rate=stoch_depth_rate,
+        layerscale_eps=layerscale_eps,
+    )
+
+
+register("cait_xxs_24", CaiT, **_cait(192, 24, 4, 0.05, 1e-5))
+register("cait_xxs_36", CaiT, **_cait(192, 36, 4, 0.1, 1e-6))
+register("cait_xs_24", CaiT, **_cait(288, 24, 6, 0.05, 1e-5))
+register("cait_xs_36", CaiT, **_cait(288, 36, 6, 0.1, 1e-6))
+register("cait_s_24", CaiT, **_cait(384, 24, 8, 0.1, 1e-5))
+register("cait_s_36", CaiT, **_cait(384, 36, 8, 0.2, 1e-6))
+register("cait_s_48", CaiT, **_cait(384, 48, 8, 0.3, 1e-6))
+register("cait_m_24", CaiT, **_cait(768, 24, 16, 0.2, 1e-5))
+register("cait_m_36", CaiT, **_cait(768, 36, 16, 0.3, 1e-6))
+register("cait_m_48", CaiT, **_cait(768, 48, 16, 0.4, 1e-6))
+
+# --- CvT (create_model.py:169-183; 384 per paper & cvt_test.py:14-15) -------
+register(
+    "cvt-13", CvT,
+    embed_dims=(64, 192, 384), num_layers=(1, 2, 10), num_heads=(1, 3, 6),
+)
+register(
+    "cvt-21", CvT,
+    embed_dims=(64, 192, 384), num_layers=(1, 4, 16), num_heads=(1, 3, 6),
+)
+register(
+    "cvt-w24", CvT,
+    embed_dims=(192, 768, 1024), num_layers=(2, 2, 20), num_heads=(3, 12, 16),
+)
+
+
+# --- MLP-Mixer (create_model.py:184-213; keys/layers fixed per paper) -------
+def _mixer(embed_dim, num_layers, tokens_ch, channels_ch, patch):
+    return dict(
+        embed_dim=embed_dim,
+        num_layers=num_layers,
+        tokens_hidden_ch=tokens_ch,
+        channels_hidden_ch=channels_ch,
+        patch_shape=(patch, patch),
+    )
+
+
+register("mixer_s_patch32", MLPMixer, **_mixer(512, 8, 256, 2048, 32))
+register("mixer_s_patch16", MLPMixer, **_mixer(512, 8, 256, 2048, 16))
+register("mixer_b_patch32", MLPMixer, **_mixer(768, 12, 384, 3072, 32))
+register("mixer_b_patch16", MLPMixer, **_mixer(768, 12, 384, 3072, 16))
+register("mixer_l_patch32", MLPMixer, **_mixer(1024, 24, 512, 4096, 32))
+register("mixer_l_patch16", MLPMixer, **_mixer(1024, 24, 512, 4096, 16))
+
+
+def model_names() -> list[str]:
+    return sorted(_REGISTRY)
+
+
+def create_model(
+    model_name: str,
+    *,
+    num_classes: int = 1000,
+    dtype=jnp.float32,
+    backend: Optional[str] = None,
+    **overrides,
+):
+    """Instantiate a named model config.
+
+    Args:
+      model_name: a key from :func:`model_names`.
+      num_classes: classifier width.
+      dtype: compute dtype (params stay fp32).
+      backend: attention backend ('xla' | 'pallas' | None=auto) threaded to
+        every attention block.
+      **overrides: per-call hyperparameter overrides.
+    """
+    if model_name not in _REGISTRY:
+        raise ValueError(
+            f"unknown model {model_name!r}; available: {', '.join(model_names())}"
+        )
+    cls, kwargs = _REGISTRY[model_name]
+    merged = dict(kwargs, num_classes=num_classes, dtype=dtype, **overrides)
+    # Attention-free models (MLP-Mixer) have no backend seam — skip injection.
+    if backend is not None and "backend" in cls.__dataclass_fields__:
+        merged["backend"] = backend
+    return cls(**merged)

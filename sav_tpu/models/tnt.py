@@ -1,0 +1,202 @@
+"""TNT — Transformer-in-Transformer.
+
+Reference: /root/reference/models/tnt.py:10-182. Two token streams: an inner
+transformer over per-patch "pixel" tokens and an outer transformer over patch
+tokens, with the inner stream folded into the outer one every block. The
+pixel stream folds patches into the batch dim (``[B·P, inner_tokens, C]``) —
+TPU-friendly batch-dim blocking, as in the reference. The reference's
+patch-shape index typo (tnt.py:22-25, SURVEY.md §2.9 #18) and the swapped
+S/B hyperparameters (create_model.py:50-63 vs tests, #13) are fixed in the
+registry.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from sav_tpu.models.layers import (
+    AddAbsPosEmbed,
+    FFBlock,
+    PatchEmbedBlock,
+    SelfAttentionBlock,
+)
+
+Dtype = Any
+
+
+class PixelEmbedBlock(nn.Module):
+    """Per-patch pixel tokens: each ``ph×pw`` patch becomes a grid of inner
+    tokens via a strided conv (tnt.py:10-33)."""
+
+    patch_shape: tuple[int, int]
+    inner_ch: int
+    inner_stride: int = 4
+    dtype: Dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, inputs: jax.Array) -> jax.Array:
+        b, h, w, c = inputs.shape
+        ph, pw = self.patch_shape
+        num_patches = (h // ph) * (w // pw)
+        # [B, H, W, C] -> [B*P, ph, pw, C]
+        x = inputs.reshape(b, h // ph, ph, w // pw, pw, c)
+        x = jnp.transpose(x, (0, 1, 3, 2, 4, 5)).reshape(b * num_patches, ph, pw, c)
+        x = nn.Conv(
+            features=self.inner_ch,
+            kernel_size=(7, 7),
+            strides=(self.inner_stride, self.inner_stride),
+            padding="SAME",
+            dtype=self.dtype,
+            name="proj",
+        )(x)
+        inner_tokens = x.shape[1] * x.shape[2]
+        return x.reshape(b * num_patches, inner_tokens, self.inner_ch)
+
+
+class Inner2OuterBlock(nn.Module):
+    """Fold pixel tokens into patch tokens: LN → Dense over the flattened
+    pixel dims → add at patch positions (offset 1 for CLS) (tnt.py:36-50)."""
+
+    embed_dim: int
+    dtype: Dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, pixel_tokens: jax.Array, patch_tokens: jax.Array) -> jax.Array:
+        b, num_patches_plus_1, _ = patch_tokens.shape
+        num_patches = num_patches_plus_1 - 1
+        flat = pixel_tokens.reshape(b, num_patches, -1)
+        flat = nn.LayerNorm(dtype=self.dtype)(flat)
+        fold = nn.Dense(self.embed_dim, dtype=self.dtype, name="proj")(flat)
+        return patch_tokens.at[:, 1:].add(fold)
+
+
+class EncoderBlock(nn.Module):
+    """Inner transformer on pixel tokens → fold → outer transformer (tnt.py:53-93)."""
+
+    embed_dim: int
+    num_heads: int
+    inner_num_heads: int
+    expand_ratio: float = 4.0
+    inner_expand_ratio: float = 4.0
+    attn_dropout_rate: float = 0.0
+    dropout_rate: float = 0.0
+    backend: Optional[str] = None
+    dtype: Dtype = jnp.float32
+
+    @nn.compact
+    def __call__(
+        self, pixel_tokens: jax.Array, patch_tokens: jax.Array, is_training: bool
+    ):
+        # Inner transformer (pre-LN) on [B*P, inner_tokens, inner_ch].
+        x = nn.LayerNorm(dtype=self.dtype)(pixel_tokens)
+        x = SelfAttentionBlock(
+            num_heads=self.inner_num_heads,
+            attn_dropout_rate=self.attn_dropout_rate,
+            out_dropout_rate=self.dropout_rate,
+            backend=self.backend,
+            dtype=self.dtype,
+            name="inner_attn",
+        )(x, is_training)
+        pixel_tokens = pixel_tokens + x
+        y = nn.LayerNorm(dtype=self.dtype)(pixel_tokens)
+        y = FFBlock(
+            expand_ratio=self.inner_expand_ratio,
+            dropout_rate=self.dropout_rate,
+            dtype=self.dtype,
+            name="inner_ff",
+        )(y, is_training)
+        pixel_tokens = pixel_tokens + y
+
+        patch_tokens = Inner2OuterBlock(embed_dim=self.embed_dim, dtype=self.dtype)(
+            pixel_tokens, patch_tokens
+        )
+
+        # Outer transformer on [B, P+1, embed_dim].
+        z = nn.LayerNorm(dtype=self.dtype)(patch_tokens)
+        z = SelfAttentionBlock(
+            num_heads=self.num_heads,
+            attn_dropout_rate=self.attn_dropout_rate,
+            out_dropout_rate=self.dropout_rate,
+            backend=self.backend,
+            dtype=self.dtype,
+            name="outer_attn",
+        )(z, is_training)
+        patch_tokens = patch_tokens + z
+        w = nn.LayerNorm(dtype=self.dtype)(patch_tokens)
+        w = FFBlock(
+            expand_ratio=self.expand_ratio,
+            dropout_rate=self.dropout_rate,
+            dtype=self.dtype,
+            name="outer_ff",
+        )(w, is_training)
+        patch_tokens = patch_tokens + w
+        return pixel_tokens, patch_tokens
+
+
+class TNT(nn.Module):
+    num_classes: int
+    embed_dim: int
+    inner_ch: int
+    num_layers: int
+    num_heads: int
+    inner_num_heads: int
+    patch_shape: tuple[int, int]
+    inner_stride: int = 4
+    expand_ratio: float = 4.0
+    inner_expand_ratio: float = 4.0
+    attn_dropout_rate: float = 0.0
+    dropout_rate: float = 0.0
+    backend: Optional[str] = None
+    dtype: Dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, inputs: jax.Array, is_training: bool) -> jax.Array:
+        b = inputs.shape[0]
+        pixel_tokens = PixelEmbedBlock(
+            patch_shape=self.patch_shape,
+            inner_ch=self.inner_ch,
+            inner_stride=self.inner_stride,
+            dtype=self.dtype,
+        )(inputs)
+        patch_tokens = PatchEmbedBlock(
+            patch_shape=self.patch_shape, embed_dim=self.embed_dim, dtype=self.dtype
+        )(inputs)
+        cls_tok = self.param("cls", nn.initializers.zeros, (1, 1, self.embed_dim))
+        cls_tok = jnp.broadcast_to(cls_tok.astype(patch_tokens.dtype), (b, 1, self.embed_dim))
+        patch_tokens = jnp.concatenate([cls_tok, patch_tokens], axis=1)
+
+        pixel_tokens = AddAbsPosEmbed(dtype=self.dtype, name="inner_pos_embed")(
+            pixel_tokens
+        )
+        patch_tokens = AddAbsPosEmbed(dtype=self.dtype, name="outer_pos_embed")(
+            patch_tokens
+        )
+        patch_tokens = nn.Dropout(rate=self.dropout_rate)(
+            patch_tokens, deterministic=not is_training
+        )
+
+        for i in range(self.num_layers):
+            pixel_tokens, patch_tokens = EncoderBlock(
+                embed_dim=self.embed_dim,
+                num_heads=self.num_heads,
+                inner_num_heads=self.inner_num_heads,
+                expand_ratio=self.expand_ratio,
+                inner_expand_ratio=self.inner_expand_ratio,
+                attn_dropout_rate=self.attn_dropout_rate,
+                dropout_rate=self.dropout_rate,
+                backend=self.backend,
+                dtype=self.dtype,
+                name=f"block_{i}",
+            )(pixel_tokens, patch_tokens, is_training)
+
+        out = nn.LayerNorm(dtype=self.dtype)(patch_tokens[:, 0])
+        return nn.Dense(
+            self.num_classes,
+            kernel_init=nn.initializers.zeros,
+            dtype=self.dtype,
+            name="head",
+        )(out)

@@ -1,0 +1,122 @@
+"""ViT — Vision Transformer.
+
+Capability parity with /root/reference/models/vit.py:9-99 (pre-LN encoder,
+learned absolute position embeddings, zero-init CLS token and head), with the
+attention core running on the backend-dispatched Pallas/XLA seam.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from sav_tpu.models.layers import (
+    AddAbsPosEmbed,
+    FFBlock,
+    PatchEmbedBlock,
+    SelfAttentionBlock,
+)
+
+Dtype = Any
+
+
+class EncoderBlock(nn.Module):
+    """Pre-LN transformer block: LN→MHSA→res, LN→FF→res (vit.py:9-32)."""
+
+    num_heads: int
+    expand_ratio: float = 4.0
+    attn_dropout_rate: float = 0.0
+    dropout_rate: float = 0.0
+    backend: Optional[str] = None
+    dtype: Dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, inputs: jax.Array, is_training: bool) -> jax.Array:
+        x = nn.LayerNorm(dtype=self.dtype)(inputs)
+        x = SelfAttentionBlock(
+            num_heads=self.num_heads,
+            attn_dropout_rate=self.attn_dropout_rate,
+            out_dropout_rate=self.dropout_rate,
+            backend=self.backend,
+            dtype=self.dtype,
+        )(x, is_training)
+        x = x + inputs
+        y = nn.LayerNorm(dtype=self.dtype)(x)
+        y = FFBlock(
+            expand_ratio=self.expand_ratio,
+            dropout_rate=self.dropout_rate,
+            dtype=self.dtype,
+        )(y, is_training)
+        return x + y
+
+
+class Encoder(nn.Module):
+    """Abs pos-emb + dropout, N pre-LN blocks, final LN (vit.py:35-58)."""
+
+    num_layers: int
+    num_heads: int
+    expand_ratio: float = 4.0
+    attn_dropout_rate: float = 0.0
+    dropout_rate: float = 0.0
+    backend: Optional[str] = None
+    dtype: Dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, inputs: jax.Array, is_training: bool) -> jax.Array:
+        x = AddAbsPosEmbed(dtype=self.dtype)(inputs)
+        x = nn.Dropout(rate=self.dropout_rate)(x, deterministic=not is_training)
+        for i in range(self.num_layers):
+            x = EncoderBlock(
+                num_heads=self.num_heads,
+                expand_ratio=self.expand_ratio,
+                attn_dropout_rate=self.attn_dropout_rate,
+                dropout_rate=self.dropout_rate,
+                backend=self.backend,
+                dtype=self.dtype,
+                name=f"block_{i}",
+            )(x, is_training)
+        return nn.LayerNorm(dtype=self.dtype)(x)
+
+
+class ViT(nn.Module):
+    """inputs ``[B, H, W, C]`` NHWC → logits ``[B, num_classes]`` (vit.py:61-99)."""
+
+    num_classes: int
+    embed_dim: int
+    num_layers: int
+    num_heads: int
+    patch_shape: tuple[int, int]
+    expand_ratio: float = 4.0
+    attn_dropout_rate: float = 0.0
+    dropout_rate: float = 0.0
+    backend: Optional[str] = None
+    dtype: Dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, inputs: jax.Array, is_training: bool) -> jax.Array:
+        x = PatchEmbedBlock(
+            patch_shape=self.patch_shape, embed_dim=self.embed_dim, dtype=self.dtype
+        )(inputs)
+        b = x.shape[0]
+        cls_tok = self.param("cls", nn.initializers.zeros, (1, 1, self.embed_dim))
+        cls_tok = jnp.broadcast_to(cls_tok.astype(x.dtype), (b, 1, self.embed_dim))
+        x = jnp.concatenate([cls_tok, x], axis=1)
+        x = Encoder(
+            num_layers=self.num_layers,
+            num_heads=self.num_heads,
+            expand_ratio=self.expand_ratio,
+            attn_dropout_rate=self.attn_dropout_rate,
+            dropout_rate=self.dropout_rate,
+            backend=self.backend,
+            dtype=self.dtype,
+        )(x, is_training)
+        cls_out = x[:, 0]
+        return nn.Dense(
+            self.num_classes,
+            kernel_init=nn.initializers.zeros,
+            dtype=self.dtype,
+            name="head",
+        )(cls_out)

@@ -1,0 +1,119 @@
+"""Scaled dot-product attention cores with a pluggable TPU backend.
+
+Functional equivalent of the einsum pipeline inside the reference's
+``AttentionBlock`` (/root/reference/models/layers/attentions/attention.py:39-57):
+``logits = einsum('...qhd,...khd->...hqk', q, k); softmax; einsum('...hqk,...khd->...qhd')``.
+
+Layout convention everywhere in this framework: ``[batch..., length, heads, head_dim]``
+(the natural output of ``nn.DenseGeneral`` head-splitting), matching the
+reference. The Pallas path transposes to ``[B*H, L, D]`` internally.
+
+``backend``:
+  - ``'xla'``    — pure jnp/einsum; the numerics reference. Supports bias,
+                   attention dropout, arbitrary leading batch dims.
+  - ``'pallas'`` — fused Pallas TPU flash-attention kernel
+                   (:mod:`sav_tpu.ops.flash_attention`). Deterministic only
+                   (attention dropout falls back to XLA).
+  - ``'auto'``   — pallas on TPU when eligible, else xla.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from sav_tpu.ops import flash_attention as _flash
+
+
+def _on_tpu() -> bool:
+    try:
+        return jax.default_backend() == "tpu"
+    except RuntimeError:  # pragma: no cover - no backend at all
+        return False
+
+
+def xla_attention(
+    query: jax.Array,
+    key: jax.Array,
+    value: jax.Array,
+    bias: Optional[jax.Array] = None,
+    *,
+    scale: Optional[float] = None,
+    dropout_rate: float = 0.0,
+    dropout_rng: Optional[jax.Array] = None,
+    deterministic: bool = True,
+    logits_dtype=jnp.float32,
+) -> jax.Array:
+    """Reference attention core in pure XLA ops.
+
+    Args:
+      query: ``[..., q_len, heads, head_dim]``.
+      key, value: ``[..., kv_len, heads, head_dim]``.
+      bias: optional logits bias broadcastable to ``[..., heads, q_len, kv_len]``.
+      scale: logit scale; defaults to ``head_dim ** -0.5`` (attention.py:39).
+      logits_dtype: dtype for softmax math; fp32 keeps bf16 runs stable.
+
+    Returns:
+      ``[..., q_len, heads, head_dim]`` in the query dtype.
+    """
+    if scale is None:
+        scale = query.shape[-1] ** -0.5
+    q = query * jnp.asarray(scale, dtype=query.dtype)
+    logits = jnp.einsum("...qhd,...khd->...hqk", q, key, preferred_element_type=logits_dtype)
+    if bias is not None:
+        logits = logits + bias.astype(logits_dtype)
+    probs = jax.nn.softmax(logits, axis=-1)
+    if dropout_rate > 0.0 and not deterministic:
+        if dropout_rng is None:
+            raise ValueError("dropout_rng required for non-deterministic attention dropout")
+        keep = jax.random.bernoulli(dropout_rng, 1.0 - dropout_rate, probs.shape)
+        probs = probs * keep.astype(probs.dtype) / (1.0 - dropout_rate)
+    probs = probs.astype(value.dtype)
+    return jnp.einsum("...hqk,...khd->...qhd", probs, value)
+
+
+def dot_product_attention(
+    query: jax.Array,
+    key: jax.Array,
+    value: jax.Array,
+    bias: Optional[jax.Array] = None,
+    *,
+    scale: Optional[float] = None,
+    dropout_rate: float = 0.0,
+    dropout_rng: Optional[jax.Array] = None,
+    deterministic: bool = True,
+    backend: Optional[str] = None,
+) -> jax.Array:
+    """Backend-dispatched attention. See module docstring."""
+    backend = backend or "auto"
+    if backend not in ("auto", "xla", "pallas"):
+        raise ValueError(f"unknown attention backend: {backend!r}")
+
+    has_dropout = dropout_rate > 0.0 and not deterministic
+    pallas_ok = (
+        not has_dropout
+        and query.ndim == 4  # [B, L, H, D] — flash path handles the common case
+        and key.ndim == 4
+        and (bias is None or bias.ndim == 4)
+    )
+    if backend == "auto":
+        backend = "pallas" if (pallas_ok and _on_tpu()) else "xla"
+    if backend == "pallas":
+        if not pallas_ok:
+            raise ValueError(
+                "pallas attention backend requires 4-D [B, L, H, D] inputs and "
+                "deterministic mode (attention dropout runs on the XLA path)"
+            )
+        return _flash.flash_attention(query, key, value, bias, scale=scale)
+    return xla_attention(
+        query,
+        key,
+        value,
+        bias,
+        scale=scale,
+        dropout_rate=dropout_rate,
+        dropout_rng=dropout_rng,
+        deterministic=deterministic,
+    )

@@ -1,0 +1,255 @@
+"""Fused Pallas TPU flash attention.
+
+The TPU execution backend for every attention family in the layer zoo
+(SURVEY.md §2.1, BASELINE.json north star): a blockwise online-softmax kernel
+that streams K/V tiles through VMEM, keeps the running ``(max, sum, acc)``
+statistics in scratch, and never materializes the ``[B, H, Lq, Lk]`` logits
+in HBM. An optional additive bias input carries 2-D relative-position logits
+(BoTNet) or masks through the fused softmax.
+
+Differentiation: ``flash_attention`` is a ``jax.custom_vjp``; the backward
+pass recomputes attention with XLA einsums (flash-style recompute — no
+saved probabilities). Sequence lengths in the reference's model zoo are
+≤ ~800 tokens, so the O(L²) backward workspace is small; a fully blocked
+Pallas backward is the planned upgrade.
+
+Numerics: logits/softmax/accumulation in float32 regardless of input dtype;
+the P·V matmul runs in the value dtype on the MXU (bf16 in, f32 accumulate).
+Cross-checked against :func:`sav_tpu.ops.attention.xla_attention` in
+``tests/test_flash_attention.py``.
+
+On non-TPU backends the kernel runs in Pallas interpreter mode, so the same
+code path is testable on the CPU mesh.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+_NEG_INF = float("-inf")
+
+
+def _round_up(x: int, m: int) -> int:
+    return ((x + m - 1) // m) * m
+
+
+def _kernel(
+    q_ref,
+    k_ref,
+    v_ref,
+    *rest,
+    has_bias: bool,
+    scale: float,
+    kv_len: int,
+    block_kv: int,
+    num_kv_blocks: int,
+):
+    """Online-softmax flash kernel; ``rest`` = ([bias_ref], o_ref, m, l, acc)."""
+    bias_ref = rest[0] if has_bias else None
+    o_ref, m_scr, l_scr, acc_scr = rest[1 if has_bias else 0 :]
+    ki = pl.program_id(2)
+
+    @pl.when(ki == 0)
+    def _init():
+        m_scr[...] = jnp.full_like(m_scr, _NEG_INF)
+        l_scr[...] = jnp.zeros_like(l_scr)
+        acc_scr[...] = jnp.zeros_like(acc_scr)
+
+    q = q_ref[0]  # [block_q, d]
+    k = k_ref[0]  # [block_kv, d]
+    s = jax.lax.dot_general(
+        q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+    )
+    s = s * scale
+    if has_bias:
+        s = s + bias_ref[0].astype(jnp.float32)
+    if kv_len % block_kv != 0:
+        col = ki * block_kv + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+        s = jnp.where(col < kv_len, s, _NEG_INF)
+
+    m_prev = m_scr[:, 0:1]
+    l_prev = l_scr[:, 0:1]
+    m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
+    alpha = jnp.exp(m_prev - m_new)
+    p = jnp.exp(s - m_new)
+    l_new = alpha * l_prev + jnp.sum(p, axis=-1, keepdims=True)
+    m_scr[...] = jnp.broadcast_to(m_new, m_scr.shape)
+    l_scr[...] = jnp.broadcast_to(l_new, l_scr.shape)
+    v = v_ref[0]
+    pv = jax.lax.dot_general(
+        p.astype(v.dtype), v, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
+    )
+    acc_scr[...] = acc_scr[...] * alpha + pv
+
+    @pl.when(ki == num_kv_blocks - 1)
+    def _finalize():
+        o_ref[0] = (acc_scr[...] / l_scr[:, 0:1]).astype(o_ref.dtype)
+
+
+def _flash_forward(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    bias: Optional[jax.Array],
+    scale: float,
+    block_q: int,
+    block_kv: int,
+    interpret: Optional[bool],
+) -> jax.Array:
+    """Run the kernel. Layout in/out: ``[B, L, H, D]``."""
+    batch, q_len, heads, dim = q.shape
+    kv_len = k.shape[1]
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+
+    # [B, L, H, D] -> [B*H, L, D]
+    def to_bhld(x):
+        b, l, h, d = x.shape
+        return jnp.transpose(x, (0, 2, 1, 3)).reshape(b * h, l, d)
+
+    qf, kf, vf = to_bhld(q), to_bhld(k), to_bhld(v)
+
+    dim_p = _round_up(dim, 128)
+    block_q = min(block_q, _round_up(q_len, 16))
+    block_kv = min(block_kv, _round_up(kv_len, 16))
+    q_len_p = _round_up(q_len, block_q)
+    kv_len_p = _round_up(kv_len, block_kv)
+
+    def pad3(x, lp):
+        return jnp.pad(x, ((0, 0), (0, lp - x.shape[1]), (0, dim_p - x.shape[2])))
+
+    qf, kf, vf = pad3(qf, q_len_p), pad3(kf, kv_len_p), pad3(vf, kv_len_p)
+
+    num_q_blocks = q_len_p // block_q
+    num_kv_blocks = kv_len_p // block_kv
+    grid = (batch * heads, num_q_blocks, num_kv_blocks)
+
+    in_specs = [
+        pl.BlockSpec((1, block_q, dim_p), lambda b, i, j: (b, i, 0)),
+        pl.BlockSpec((1, block_kv, dim_p), lambda b, i, j: (b, j, 0)),
+        pl.BlockSpec((1, block_kv, dim_p), lambda b, i, j: (b, j, 0)),
+    ]
+    args = [qf, kf, vf]
+    if bias is not None:
+        bias = jnp.broadcast_to(bias, bias.shape[:-2] + (q_len, kv_len))
+        bb, bh = bias.shape[0], bias.shape[1]
+        if (bb, bh) not in ((batch, heads), (1, 1)):
+            bias = jnp.broadcast_to(bias, (batch, heads) + bias.shape[-2:])
+            bb, bh = batch, heads
+        biasf = bias.reshape(bb * bh, q_len, kv_len)
+        biasf = jnp.pad(
+            biasf, ((0, 0), (0, q_len_p - q_len), (0, kv_len_p - kv_len))
+        )
+        shared = bb * bh == 1
+        if shared:
+            bias_index = lambda b, i, j: (0, i, j)
+        else:
+            bias_index = lambda b, i, j: (b, i, j)
+        in_specs.append(pl.BlockSpec((1, block_q, block_kv), bias_index))
+        args.append(biasf)
+
+    kernel = functools.partial(
+        _kernel,
+        has_bias=bias is not None,
+        scale=scale,
+        kv_len=kv_len,
+        block_kv=block_kv,
+        num_kv_blocks=num_kv_blocks,
+    )
+
+    out = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=in_specs,
+        out_specs=pl.BlockSpec((1, block_q, dim_p), lambda b, i, j: (b, i, 0)),
+        out_shape=jax.ShapeDtypeStruct((batch * heads, q_len_p, dim_p), q.dtype),
+        scratch_shapes=[
+            pltpu.VMEM((block_q, 128), jnp.float32),
+            pltpu.VMEM((block_q, 128), jnp.float32),
+            pltpu.VMEM((block_q, dim_p), jnp.float32),
+        ],
+        interpret=interpret,
+    )(*args)
+
+    out = out[:, :q_len, :dim]
+    out = out.reshape(batch, heads, q_len, dim)
+    return jnp.transpose(out, (0, 2, 1, 3))
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7))
+def _flash(q, k, v, bias, scale, block_q, block_kv, interpret):
+    return _flash_forward(q, k, v, bias, scale, block_q, block_kv, interpret)
+
+
+def _flash_fwd(q, k, v, bias, scale, block_q, block_kv, interpret):
+    out = _flash_forward(q, k, v, bias, scale, block_q, block_kv, interpret)
+    return out, (q, k, v, bias)
+
+
+def _flash_bwd(scale, block_q, block_kv, interpret, residuals, g):
+    """Flash-style recompute backward in XLA (fp32 softmax math)."""
+    q, k, v, bias = residuals
+    del block_q, block_kv, interpret
+    s = jnp.einsum("bqhd,bkhd->bhqk", q, k, preferred_element_type=jnp.float32) * scale
+    if bias is not None:
+        s = s + bias.astype(jnp.float32)
+    p = jax.nn.softmax(s, axis=-1)  # [B, H, Lq, Lk] fp32
+    g32 = g.astype(jnp.float32)
+    dv = jnp.einsum("bhqk,bqhd->bkhd", p, g32, preferred_element_type=jnp.float32)
+    dp = jnp.einsum("bqhd,bkhd->bhqk", g32, v.astype(jnp.float32))
+    ds = p * (dp - jnp.sum(p * dp, axis=-1, keepdims=True))  # [B, H, Lq, Lk]
+    dq = jnp.einsum("bhqk,bkhd->bqhd", ds, k.astype(jnp.float32)) * scale
+    dk = jnp.einsum("bhqk,bqhd->bkhd", ds, q.astype(jnp.float32)) * scale
+    if bias is not None:
+        dbias = ds
+        # Un-broadcast to the original bias shape.
+        for axis in range(dbias.ndim):
+            if bias.shape[axis] == 1 and dbias.shape[axis] != 1:
+                dbias = jnp.sum(dbias, axis=axis, keepdims=True)
+        dbias = dbias.astype(bias.dtype)
+    else:
+        dbias = None
+    return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype), dbias
+
+
+_flash.defvjp(_flash_fwd, _flash_bwd)
+
+
+def flash_attention(
+    query: jax.Array,
+    key: jax.Array,
+    value: jax.Array,
+    bias: Optional[jax.Array] = None,
+    *,
+    scale: Optional[float] = None,
+    block_q: int = 128,
+    block_kv: int = 128,
+    interpret: Optional[bool] = None,
+) -> jax.Array:
+    """Fused flash attention.
+
+    Args:
+      query: ``[B, q_len, heads, head_dim]``.
+      key, value: ``[B, kv_len, heads, head_dim]``.
+      bias: optional additive logits bias, broadcastable to
+        ``[B, heads, q_len, kv_len]`` (e.g. BoTNet relative-position logits).
+      scale: logit scale, default ``head_dim ** -0.5``.
+      block_q / block_kv: VMEM tile sizes (clamped for short sequences).
+      interpret: force Pallas interpreter mode; default = auto (on for non-TPU).
+
+    Returns:
+      ``[B, q_len, heads, head_dim]`` in the query dtype.
+    """
+    if query.ndim != 4:
+        raise ValueError(f"expected [B, L, H, D] inputs, got {query.shape}")
+    if scale is None:
+        scale = query.shape[-1] ** -0.5
+    if bias is not None and bias.ndim != 4:
+        raise ValueError(f"bias must be 4-D broadcastable, got {bias.shape}")
+    return _flash(query, key, value, bias, float(scale), block_q, block_kv, interpret)

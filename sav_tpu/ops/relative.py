@@ -1,0 +1,63 @@
+"""2-D relative position logits for BoTNet-style attention.
+
+Functional, fixed rebuild of the reference's ``RelativeLogits`` machinery
+(/root/reference/models/botnet.py:70-141): per-axis 1-D relative logits from
+learned ``(2L-1, d)`` tables, converted relative→absolute with the
+pad-reshape-slice trick, combined as ``rel_h + rel_w``. The reference's
+output einsum bug (botnet.py:194, SURVEY.md §2.9 #3) does not apply here —
+this op only produces the logits bias; attention consumes it via the shared
+``dot_product_attention`` cores (XLA or Pallas, where it enters the fused
+softmax as a bias term).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def rel_to_abs(x: jax.Array) -> jax.Array:
+    """Convert relative-indexed logits ``[..., L, 2L-1]`` to absolute ``[..., L, L]``.
+
+    ``out[..., i, j] == x[..., i, j - i + L - 1]`` — the classic pad/reshape/slice
+    trick (no gathers, TPU-friendly).
+    """
+    *lead, length, rel = x.shape
+    if rel != 2 * length - 1:
+        raise ValueError(f"expected last dim {2 * length - 1}, got {rel}")
+    pad = [(0, 0)] * len(lead)
+    x = jnp.pad(x, pad + [(0, 0), (0, 1)])  # [..., L, 2L]
+    x = x.reshape(*lead, length * 2 * length)
+    x = jnp.pad(x, pad + [(0, length - 1)])  # [..., 2L² + L - 1]
+    x = x.reshape(*lead, length + 1, 2 * length - 1)
+    return x[..., :length, length - 1 :]
+
+
+def _relative_logits_1d(q: jax.Array, rel_k: jax.Array) -> jax.Array:
+    """``q: [B, h, X, Y, d]``, ``rel_k: [2Y-1, d]`` → ``[B, h, X, Y, Y]``."""
+    logits = jnp.einsum("bhxyd,md->bhxym", q, rel_k, preferred_element_type=jnp.float32)
+    return rel_to_abs(logits)
+
+
+def relative_logits_2d(q: jax.Array, rel_k_h: jax.Array, rel_k_w: jax.Array) -> jax.Array:
+    """Full 2-D relative position logits.
+
+    Args:
+      q: queries on the feature-map grid, ``[B, heads, H, W, d]``.
+      rel_k_h: ``[2H-1, d]`` learned height-relative embedding table.
+      rel_k_w: ``[2W-1, d]`` learned width-relative embedding table.
+
+    Returns:
+      ``[B, heads, H, W, H, W]`` float32 logits where entry
+      ``[b, n, x, y, X, Y] = q[b,n,x,y]·rel_k_h[X-x+H-1] + q[b,n,x,y]·rel_k_w[Y-y+W-1]``.
+    """
+    b, h, height, width, _ = q.shape
+    # Width logits: independent of the key row → broadcast over X.
+    rel_w = _relative_logits_1d(q, rel_k_w)  # [B, h, H, W, W] = [b,n,x,y,Y]
+    rel_w = jnp.broadcast_to(rel_w[:, :, :, :, None, :], (b, h, height, width, height, width))
+    # Height logits: transpose the grid, compute along H, transpose back.
+    q_t = jnp.swapaxes(q, 2, 3)  # [B, h, W, H, d]
+    rel_h = _relative_logits_1d(q_t, rel_k_h)  # [B, h, W, H, H] = [b,n,y,x,X]
+    rel_h = jnp.transpose(rel_h, (0, 1, 3, 2, 4))  # [b,n,x,y,X]
+    rel_h = jnp.broadcast_to(rel_h[:, :, :, :, :, None], (b, h, height, width, height, width))
+    return rel_w + rel_h

@@ -1,0 +1,51 @@
+"""Fixed sinusoidal and rotary position embeddings.
+
+Working rebuild of the reference's broken rotary path
+(/root/reference/models/layers/position_embed.py:8-45 — undefined ``self.dim``,
+malformed ``10e4 ** intervals / dim`` frequency formula; SURVEY.md §2.9 #12).
+Frequencies here follow the standard RoPE formulation
+``inv_freq_i = 10000 ** (-2i / dim)``.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def fixed_positional_embedding(seq_len: int, dim: int, dtype=jnp.float32):
+    """Sinusoidal (sin, cos) tables of shape ``[seq_len, dim]`` each.
+
+    Each frequency is repeated twice along the feature axis so the tables
+    align with :func:`rotate_every_two` pairing.
+    """
+    if dim % 2 != 0:
+        raise ValueError(f"rotary dim must be even, got {dim}")
+    inv_freq = 1.0 / (10000 ** (jnp.arange(0, dim, 2, dtype=jnp.float32) / dim))
+    t = jnp.arange(seq_len, dtype=jnp.float32)
+    freqs = jnp.einsum("i,j->ij", t, inv_freq)  # [L, dim/2]
+    freqs = jnp.repeat(freqs, 2, axis=-1)  # [L, dim]
+    return jnp.sin(freqs).astype(dtype), jnp.cos(freqs).astype(dtype)
+
+
+def rotate_every_two(x: jax.Array) -> jax.Array:
+    """``(x0, x1, x2, x3, ...) -> (-x1, x0, -x3, x2, ...)`` along the last axis."""
+    x1 = x[..., 0::2]
+    x2 = x[..., 1::2]
+    out = jnp.stack([-x2, x1], axis=-1)
+    return out.reshape(x.shape)
+
+
+def apply_rotary_pos_emb(x: jax.Array, sincos) -> jax.Array:
+    """Apply RoPE to ``x: [..., seq_len, dim]`` (or ``[..., seq_len, heads, dim]``).
+
+    ``sincos``: pair of ``[seq_len, dim]`` tables from
+    :func:`fixed_positional_embedding`.
+    """
+    sin, cos = sincos
+    if x.ndim == 4:  # [B, L, H, D] — broadcast over heads
+        sin = sin[None, :, None, :]
+        cos = cos[None, :, None, :]
+    sin = sin.astype(x.dtype)
+    cos = cos.astype(x.dtype)
+    return x * cos + rotate_every_two(x) * sin

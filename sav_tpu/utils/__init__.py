@@ -1,0 +1,3 @@
+from sav_tpu.utils.metrics import topk_correct, accuracy_topk, cross_entropy
+
+__all__ = ["topk_correct", "accuracy_topk", "cross_entropy"]

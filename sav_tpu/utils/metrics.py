@@ -1,0 +1,50 @@
+"""Metrics.
+
+Capability parity with the reference's ``utils.py`` (``topk_correct``,
+/root/reference/utils.py:20-37), rebuilt without the vmapped ``any_in``
+gather — a single ``top_k``/comparison pattern XLA fuses cleanly on TPU.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def topk_correct(logits: jax.Array, labels: jax.Array, topk: tuple[int, ...] = (1, 5)):
+    """Per-example top-k correctness masks.
+
+    Args:
+      logits: ``[batch, num_classes]`` float array.
+      labels: ``[batch]`` int class ids.
+      topk: tuple of k values.
+
+    Returns:
+      dict ``{f'top_{k}_acc': [batch] float mask}`` — 1.0 where the true label
+      is within the top-k predictions.
+    """
+    max_k = max(topk)
+    _, top_ids = jax.lax.top_k(logits, max_k)  # [batch, max_k]
+    hit = top_ids == labels[:, None]  # [batch, max_k]
+    out = {}
+    for k in topk:
+        out[f"top_{k}_acc"] = jnp.any(hit[:, :k], axis=-1).astype(jnp.float32)
+    return out
+
+
+def accuracy_topk(logits: jax.Array, labels: jax.Array, topk: tuple[int, ...] = (1, 5)):
+    """Mean top-k accuracies over the batch."""
+    masks = topk_correct(logits, labels, topk)
+    return {k: jnp.mean(v) for k, v in masks.items()}
+
+
+def cross_entropy(logits: jax.Array, label_probs: jax.Array) -> jax.Array:
+    """Mean softmax cross entropy against (possibly soft/mixed) label distributions.
+
+    Loss math runs in float32 regardless of logits dtype (the reference casts
+    logits to fp32 before the loss, train.py:89-90).
+    """
+    logits = logits.astype(jnp.float32)
+    label_probs = label_probs.astype(jnp.float32)
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    return -jnp.mean(jnp.sum(label_probs * logp, axis=-1))

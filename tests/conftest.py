@@ -1,0 +1,26 @@
+"""Test harness: force an 8-device virtual CPU mesh before JAX backends init.
+
+The environment's axon plugin overrides ``JAX_PLATFORMS`` (it resets the
+config to ``axon,cpu`` at import), so forcing CPU must go through
+``jax.config.update`` after import — NOT the env var. This mirrors how the
+driver validates multi-chip sharding without real chips.
+"""
+
+import os
+
+os.environ["XLA_FLAGS"] = (
+    os.environ.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=8"
+)
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import pytest
+
+
+@pytest.fixture(scope="session")
+def devices():
+    devs = jax.devices()
+    assert len(devs) == 8, f"expected 8 virtual CPU devices, got {len(devs)}"
+    return devs

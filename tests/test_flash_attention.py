@@ -1,0 +1,127 @@
+"""Pallas flash attention vs XLA reference numerics (BASELINE.json north star:
+'every models/*_test.py cross-checks Pallas vs. XLA numerics')."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from sav_tpu.ops import flash_attention, xla_attention, relative_logits_2d
+from sav_tpu.ops.attention import dot_product_attention
+from sav_tpu.ops.relative import rel_to_abs
+
+
+def _qkv(b=2, lq=197, lk=None, h=4, d=64, dtype=jnp.float32, seed=0):
+    lk = lk or lq
+    ks = jax.random.split(jax.random.PRNGKey(seed), 3)
+    q = jax.random.normal(ks[0], (b, lq, h, d), dtype)
+    k = jax.random.normal(ks[1], (b, lk, h, d), dtype)
+    v = jax.random.normal(ks[2], (b, lk, h, d), dtype)
+    return q, k, v
+
+
+@pytest.mark.parametrize(
+    "lq,lk,d",
+    [
+        (197, 197, 64),  # ViT-B/16 @ 224
+        (128, 128, 128),  # aligned
+        (50, 50, 32),  # ViT @ 32x32-ish, tiny head dim
+        (1, 197, 64),  # class attention: single query row
+        (196, 49, 64),  # CvT: downsampled K/V
+        (785, 785, 40),  # TNT-B outer-ish, odd head dim
+    ],
+)
+def test_flash_matches_xla(lq, lk, d):
+    q, k, v = _qkv(lq=lq, lk=lk, d=d)
+    ref = xla_attention(q, k, v)
+    out = flash_attention(q, k, v)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5, rtol=2e-5)
+
+
+def test_flash_with_bias_matches_xla():
+    q, k, v = _qkv(lq=64, lk=64, d=32)
+    bias = jax.random.normal(jax.random.PRNGKey(9), (2, 4, 64, 64))
+    ref = xla_attention(q, k, v, bias)
+    out = flash_attention(q, k, v, bias)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5, rtol=2e-5)
+
+
+def test_flash_with_shared_bias():
+    q, k, v = _qkv(lq=33, lk=33, d=16)
+    bias = jax.random.normal(jax.random.PRNGKey(9), (1, 1, 33, 33))
+    ref = xla_attention(q, k, v, bias)
+    out = flash_attention(q, k, v, bias)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5, rtol=2e-5)
+
+
+def test_flash_gradients_match_xla():
+    q, k, v = _qkv(lq=50, lk=50, d=32)
+    bias = jax.random.normal(jax.random.PRNGKey(9), (1, 4, 50, 50))
+
+    def loss_f(fn):
+        return lambda q, k, v, b: jnp.sum(jnp.square(fn(q, k, v, b)))
+
+    gf = jax.grad(loss_f(flash_attention), argnums=(0, 1, 2, 3))(q, k, v, bias)
+    gx = jax.grad(loss_f(xla_attention), argnums=(0, 1, 2, 3))(q, k, v, bias)
+    for a, b in zip(gf, gx):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=5e-5, rtol=5e-4)
+
+
+def test_flash_bf16():
+    q, k, v = _qkv(lq=197, lk=197, d=64, dtype=jnp.bfloat16)
+    ref = xla_attention(q, k, v)
+    out = flash_attention(q, k, v)
+    assert out.dtype == jnp.bfloat16
+    np.testing.assert_allclose(
+        np.asarray(out, np.float32), np.asarray(ref, np.float32), atol=3e-2, rtol=3e-2
+    )
+
+
+def test_flash_softmax_stability():
+    """Large logit magnitudes must not overflow the online softmax."""
+    q, k, v = _qkv(lq=64, lk=64, d=32)
+    out = flash_attention(100.0 * q, 100.0 * k, v)
+    assert np.isfinite(np.asarray(out)).all()
+
+
+def test_dispatch_backends_agree():
+    q, k, v = _qkv(lq=60, lk=60, d=16)
+    out_x = dot_product_attention(q, k, v, backend="xla")
+    out_p = dot_product_attention(q, k, v, backend="pallas")
+    np.testing.assert_allclose(np.asarray(out_p), np.asarray(out_x), atol=2e-5, rtol=2e-5)
+
+
+def test_dispatch_rejects_bad_backend():
+    q, k, v = _qkv(lq=8, lk=8, d=8)
+    with pytest.raises(ValueError, match="unknown attention backend"):
+        dot_product_attention(q, k, v, backend="cuda")
+
+
+def test_rel_to_abs_indexing():
+    length = 9
+    x = jax.random.normal(jax.random.PRNGKey(0), (2, length, 2 * length - 1))
+    y = np.asarray(rel_to_abs(x))
+    xn = np.asarray(x)
+    for i in range(length):
+        for j in range(length):
+            np.testing.assert_allclose(y[0, i, j], xn[0, i, j - i + length - 1], rtol=1e-6)
+
+
+def test_relative_logits_2d_offsets():
+    """Entry [x,y,X,Y] must equal q[x,y]·rel_h[X-x+H-1] + q[x,y]·rel_w[Y-y+W-1]."""
+    h_, w_, d = 3, 4, 8
+    q = jax.random.normal(jax.random.PRNGKey(0), (1, 1, h_, w_, d))
+    rel_h = jax.random.normal(jax.random.PRNGKey(1), (2 * h_ - 1, d))
+    rel_w = jax.random.normal(jax.random.PRNGKey(2), (2 * w_ - 1, d))
+    out = np.asarray(relative_logits_2d(q, rel_h, rel_w))
+    qn, rh, rw = map(np.asarray, (q, rel_h, rel_w))
+    for x in range(h_):
+        for y in range(w_):
+            for xx in range(h_):
+                for yy in range(w_):
+                    expected = qn[0, 0, x, y] @ rh[xx - x + h_ - 1] + qn[0, 0, x, y] @ rw[
+                        yy - y + w_ - 1
+                    ]
+                    np.testing.assert_allclose(
+                        out[0, 0, x, y, xx, yy], expected, rtol=1e-4
+                    )

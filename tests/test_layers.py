@@ -1,0 +1,223 @@
+"""Layer zoo unit tests — the coverage tier the reference lacked (SURVEY.md §4)."""
+
+import chex
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from sav_tpu.models import layers
+
+
+def _rngs():
+    return {
+        "params": jax.random.PRNGKey(0),
+        "dropout": jax.random.PRNGKey(1),
+        "stochastic_depth": jax.random.PRNGKey(2),
+    }
+
+
+def _nonparam_rngs():
+    return {k: v for k, v in _rngs().items() if k != "params"}
+
+
+def test_attention_block_shapes():
+    block = layers.SelfAttentionBlock(num_heads=4)
+    x = jax.random.normal(jax.random.PRNGKey(0), (2, 16, 32))
+    variables = block.init(_rngs(), x, is_training=False)
+    out = block.apply(variables, x, is_training=False)
+    chex.assert_shape(out, (2, 16, 32))
+
+
+def test_attention_cross():
+    block = layers.AttentionBlock(num_heads=2, out_ch=24)
+    q = jax.random.normal(jax.random.PRNGKey(0), (2, 5, 16))
+    kv = jax.random.normal(jax.random.PRNGKey(1), (2, 9, 16))
+    variables = block.init(_rngs(), q, kv, is_training=False)
+    out = block.apply(variables, q, kv, is_training=False)
+    chex.assert_shape(out, (2, 5, 24))
+
+
+def test_talking_heads_changes_result():
+    x = jax.random.normal(jax.random.PRNGKey(0), (2, 8, 32))
+    plain = layers.SelfAttentionBlock(num_heads=4)
+    th = layers.SelfAttentionBlock(num_heads=4, talking_heads=True)
+    v_th = th.init(_rngs(), x, is_training=False)
+    out = th.apply(v_th, x, is_training=False)
+    chex.assert_shape(out, (2, 8, 32))
+    assert "pre_softmax" in v_th["params"] and "post_softmax" in v_th["params"]
+    del plain
+
+
+def test_class_attention_single_query():
+    block = layers.ClassSelfAttentionBlock(num_heads=4)
+    x = jax.random.normal(jax.random.PRNGKey(0), (2, 16, 32))
+    variables = block.init(_rngs(), x, is_training=False)
+    out = block.apply(variables, x, is_training=False)
+    chex.assert_shape(out, (2, 1, 32))
+
+
+def test_lc_attention_last_query():
+    block = layers.LCSelfAttentionBlock(num_heads=4)
+    x = jax.random.normal(jax.random.PRNGKey(0), (2, 12, 32))
+    variables = block.init(_rngs(), x, is_training=False)
+    out = block.apply(variables, x, is_training=False)
+    chex.assert_shape(out, (2, 1, 32))
+
+
+def test_cvt_attention_downsampled_kv():
+    block = layers.CvTSelfAttentionBlock(num_heads=2)
+    tokens = jax.random.normal(jax.random.PRNGKey(0), (2, 64, 32))
+    variables = block.init(_rngs(), tokens, (8, 8), is_training=False)
+    out, _ = block.apply(
+        variables, tokens, (8, 8), is_training=True,
+        rngs=_nonparam_rngs(), mutable=["batch_stats"],
+    )
+    chex.assert_shape(out, (2, 64, 32))
+
+
+def test_cvt_attention_with_cls():
+    block = layers.CvTSelfAttentionBlock(num_heads=2, with_cls=True)
+    tokens = jax.random.normal(jax.random.PRNGKey(0), (2, 65, 32))
+    variables = block.init(_rngs(), tokens, (8, 8), is_training=False)
+    out = block.apply(variables, tokens, (8, 8), is_training=False)
+    chex.assert_shape(out, (2, 65, 32))
+
+
+def test_bot_mhsa():
+    block = layers.BoTMHSA(num_heads=4, head_ch=16)
+    x = jax.random.normal(jax.random.PRNGKey(0), (2, 8, 8, 32))
+    variables = block.init(_rngs(), x)
+    out = block.apply(variables, x)
+    chex.assert_shape(out, (2, 8, 8, 64))
+    assert variables["params"]["rel_emb_h"].shape == (15, 16)
+    assert variables["params"]["rel_emb_w"].shape == (15, 16)
+
+
+def test_bot_mhsa_relative_logits_are_wired():
+    """Zeroing the learned relative tables must change the output — guards the
+    reference's bug class where the relative path silently dropped out of the
+    attention result (SURVEY.md §2.9 #3). Exact offset indexing is covered by
+    test_flash_attention.test_relative_logits_2d_offsets."""
+    block = layers.BoTMHSA(num_heads=2, head_ch=8)
+    x = jax.random.normal(jax.random.PRNGKey(3), (1, 4, 4, 16))
+    variables = block.init(_rngs(), x)
+    out = block.apply(variables, x)
+    zeroed = jax.tree.map(lambda a: a, variables)
+    zeroed["params"]["rel_emb_h"] = jnp.zeros_like(zeroed["params"]["rel_emb_h"])
+    zeroed["params"]["rel_emb_w"] = jnp.zeros_like(zeroed["params"]["rel_emb_w"])
+    out_zeroed = block.apply(zeroed, x)
+    assert not np.allclose(np.asarray(out), np.asarray(out_zeroed))
+
+
+def test_ff_block():
+    block = layers.FFBlock(expand_ratio=2)
+    x = jax.random.normal(jax.random.PRNGKey(0), (2, 10, 32))
+    variables = block.init(_rngs(), x, is_training=False)
+    out = block.apply(variables, x, is_training=False)
+    chex.assert_shape(out, (2, 10, 32))
+    assert variables["params"]["fc1"]["kernel"].shape == (32, 64)
+
+
+def test_leff_block():
+    block = layers.LeFFBlock(expand_ratio=2)
+    x = jax.random.normal(jax.random.PRNGKey(0), (2, 17, 32))  # CLS + 4x4 grid
+    variables = block.init(_rngs(), x, is_training=False)
+    out, _ = block.apply(
+        variables, x, is_training=True, rngs=_nonparam_rngs(), mutable=["batch_stats"]
+    )
+    chex.assert_shape(out, (2, 17, 32))
+
+
+def test_patch_embed():
+    block = layers.PatchEmbedBlock(patch_shape=(8, 8), embed_dim=48)
+    x = jax.random.normal(jax.random.PRNGKey(0), (2, 32, 32, 3))
+    variables = block.init(_rngs(), x)
+    out = block.apply(variables, x)
+    chex.assert_shape(out, (2, 16, 48))
+
+
+def test_image2token():
+    block = layers.Image2TokenBlock(patch_shape=(4, 4), embed_dim=48)
+    x = jax.random.normal(jax.random.PRNGKey(0), (2, 64, 64, 3))
+    variables = block.init(_rngs(), x, is_training=False)
+    out = block.apply(variables, x, is_training=False)
+    # 64 → conv s2 → 32 → pool s2 → 16 → patch 4 → 4x4 grid
+    chex.assert_shape(out, (2, 16, 48))
+
+
+def test_abs_pos_embed():
+    block = layers.AddAbsPosEmbed()
+    x = jnp.zeros((2, 10, 16))
+    variables = block.init(_rngs(), x)
+    out = block.apply(variables, x)
+    chex.assert_shape(out, (2, 10, 16))
+    assert variables["params"]["pos_embed"].shape == (1, 10, 16)
+
+
+def test_rotary_preserves_norm():
+    block = layers.RotaryPositionalEmbedding()
+    x = jax.random.normal(jax.random.PRNGKey(0), (2, 10, 16))
+    out = block.apply({}, x)
+    chex.assert_shape(out, (2, 10, 16))
+    # Rotation preserves the 2-norm of each (even, odd) channel pair.
+    np.testing.assert_allclose(
+        np.linalg.norm(np.asarray(out), axis=-1),
+        np.linalg.norm(np.asarray(x), axis=-1),
+        rtol=1e-5,
+    )
+
+
+def test_fixed_pos_embed():
+    block = layers.FixedPositionalEmbedding()
+    x = jnp.zeros((1, 6, 8))
+    out = block.apply({}, x)
+    assert not np.allclose(np.asarray(out), 0.0)
+
+
+def test_layerscale_init():
+    block = layers.LayerScaleBlock(eps=1e-5)
+    x = jnp.ones((2, 4, 8))
+    variables = block.init(_rngs(), x)
+    out = block.apply(variables, x)
+    np.testing.assert_allclose(np.asarray(out), 1e-5, rtol=1e-6)
+
+
+@pytest.mark.parametrize("scale_by_keep", [True, False])
+def test_stochastic_depth(scale_by_keep):
+    block = layers.StochasticDepthBlock(drop_rate=0.5, scale_by_keep=scale_by_keep)
+    x = jnp.ones((64, 4, 8))
+    out = block.apply({}, x, is_training=True, rngs=_nonparam_rngs())
+    arr = np.asarray(out)
+    per_sample = arr.reshape(64, -1)
+    dropped = np.all(per_sample == 0, axis=-1)
+    kept_value = 2.0 if scale_by_keep else 1.0
+    kept = np.all(per_sample == kept_value, axis=-1)
+    assert np.all(dropped | kept) and dropped.any() and kept.any()
+    # Eval mode: identity.
+    np.testing.assert_array_equal(
+        np.asarray(block.apply({}, x, is_training=False)), np.asarray(x)
+    )
+
+
+def test_squeeze_excite():
+    block = layers.SqueezeExciteBlock(se_ratio=0.25)
+    x = jax.random.normal(jax.random.PRNGKey(0), (2, 8, 8, 16))
+    variables = block.init(_rngs(), x)
+    out = block.apply(variables, x)
+    chex.assert_shape(out, (2, 8, 8, 16))
+    assert variables["params"]["reduce"]["kernel"].shape == (16, 4)
+
+
+def test_dropout_rng_streams():
+    """Stochastic layers draw from their own streams, not 'params'."""
+    block = layers.SelfAttentionBlock(num_heads=2, attn_dropout_rate=0.5)
+    x = jax.random.normal(jax.random.PRNGKey(0), (2, 8, 16))
+    variables = block.init(_rngs(), x, is_training=False)
+    o1 = block.apply(
+        variables, x, is_training=True, rngs={"dropout": jax.random.PRNGKey(7)}
+    )
+    o2 = block.apply(
+        variables, x, is_training=True, rngs={"dropout": jax.random.PRNGKey(8)}
+    )
+    assert not np.allclose(np.asarray(o1), np.asarray(o2))

@@ -1,0 +1,106 @@
+#!/usr/bin/env python
+"""Headline benchmark: DeiT-S/16 ImageNet-shape training throughput per chip.
+
+Measures the full jitted train step (forward + backward + AdamW update,
+bf16 compute, label smoothing) on synthetic 224² batches — the
+BASELINE.json north-star metric (target ≥8,000 img/s/chip). Prints exactly
+one JSON line:
+
+    {"metric": ..., "value": N, "unit": "img/s/chip", "vs_baseline": N}
+
+``vs_baseline`` is value / 8000 (the driver-set north star; the reference
+itself published no numbers — BASELINE.md).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+
+BASELINE_IMG_PER_SEC_PER_CHIP = 8000.0
+
+
+def run(model_name: str, batch_size: int, steps: int, backend, image_size: int):
+    import jax
+    import numpy as np
+
+    from sav_tpu.data import synthetic_data_iterator
+    from sav_tpu.train import TrainConfig, Trainer
+
+    config = TrainConfig(
+        model_name=model_name,
+        num_classes=1000,
+        image_size=image_size,
+        compute_dtype="bfloat16",
+        attention_backend=backend,
+        global_batch_size=batch_size,
+        transpose_images=False,
+        clip_grad_norm=1.0,
+        seed=0,
+    )
+    trainer = Trainer(config)
+    state = trainer.init_state()
+    batch = next(
+        synthetic_data_iterator(
+            batch_size=batch_size,
+            image_size=image_size,
+            num_classes=1000,
+            learnable=False,
+        )
+    )
+    sharded = trainer.shard_batch(batch)
+    rng = jax.random.PRNGKey(0)
+
+    # Warmup/compile (2 steps: first compiles, second confirms steady state).
+    # Sync via device_get of the loss value — on relayed/remote platforms
+    # block_until_ready alone can return before execution completes.
+    for _ in range(2):
+        state, metrics = trainer._train_step(state, sharded, rng)
+    float(jax.device_get(metrics["loss"]))
+
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        state, metrics = trainer._train_step(state, sharded, rng)
+    float(jax.device_get(metrics["loss"]))
+    elapsed = time.perf_counter() - t0
+
+    n_chips = len(jax.devices())
+    img_per_sec = batch_size * steps / elapsed
+    return img_per_sec / n_chips, n_chips, elapsed / steps
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("--model", default="deit_s_patch16")
+    parser.add_argument("--batch-size", type=int, default=256)
+    parser.add_argument("--steps", type=int, default=20)
+    parser.add_argument("--image-size", type=int, default=224)
+    parser.add_argument(
+        "--backend",
+        default="xla",
+        choices=["xla", "pallas", "auto"],
+        help="attention backend (XLA fuses best at 197-token DeiT shapes today)",
+    )
+    args = parser.parse_args(argv)
+
+    value, n_chips, step_s = run(
+        args.model, args.batch_size, args.steps, args.backend, args.image_size
+    )
+    print(
+        json.dumps(
+            {
+                "metric": f"{args.model} train img/s/chip (bs={args.batch_size}, "
+                f"bf16, {args.backend} attention, {n_chips} chip)",
+                "value": round(value, 1),
+                "unit": "img/s/chip",
+                "vs_baseline": round(value / BASELINE_IMG_PER_SEC_PER_CHIP, 4),
+            }
+        )
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,3 @@
+from sav_tpu.data.synthetic import fake_data_iterator, synthetic_data_iterator
+
+__all__ = ["fake_data_iterator", "synthetic_data_iterator"]

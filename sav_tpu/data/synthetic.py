@@ -1,0 +1,65 @@
+"""Synthetic / fake data iterators.
+
+Parity with the reference's ``fake_data`` branch (/root/reference/
+input_pipeline.py:104-113 — correctly-shaped zero batches used as the
+built-in fake backend for driver testing), plus a random-data variant for
+train-step smoke tests (loss must decrease on a learnable signal).
+"""
+
+from __future__ import annotations
+
+from typing import Iterator, Optional
+
+import numpy as np
+
+
+def fake_data_iterator(
+    *,
+    batch_size: int,
+    image_size: int = 224,
+    num_classes: int = 1000,
+    transpose: bool = False,
+    dtype=np.float32,
+) -> Iterator[dict]:
+    """Infinite zero batches with the pipeline's exact output shapes."""
+    img_shape = (
+        (image_size, image_size, 3, batch_size)
+        if transpose
+        else (batch_size, image_size, image_size, 3)
+    )
+    images = np.zeros(img_shape, dtype)
+    labels = np.zeros((batch_size,), np.int32)
+    while True:
+        yield {"images": images, "labels": labels}
+
+
+def synthetic_data_iterator(
+    *,
+    batch_size: int,
+    image_size: int = 32,
+    num_classes: int = 10,
+    transpose: bool = False,
+    seed: int = 0,
+    num_batches: Optional[int] = None,
+    learnable: bool = True,
+    dtype=np.float32,
+) -> Iterator[dict]:
+    """Random images with (optionally) label-correlated signal.
+
+    With ``learnable=True`` the class id is embedded as a constant brightness
+    offset, so a model trained on this stream must show decreasing loss —
+    the train-step integration test the reference lacked (SURVEY.md §4).
+    """
+    rng = np.random.default_rng(seed)
+    count = 0
+    while num_batches is None or count < num_batches:
+        images = rng.standard_normal(
+            (batch_size, image_size, image_size, 3)
+        ).astype(dtype)
+        labels = rng.integers(0, num_classes, (batch_size,), dtype=np.int32)
+        if learnable:
+            images += (labels[:, None, None, None] / num_classes - 0.5) * 4.0
+        if transpose:
+            images = np.transpose(images, (1, 2, 3, 0))
+        yield {"images": images.astype(dtype), "labels": labels}
+        count += 1

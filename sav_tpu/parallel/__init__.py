@@ -1,0 +1,29 @@
+from sav_tpu.parallel.mesh import (
+    DATA_AXIS,
+    MODEL_AXIS,
+    SEQ_AXIS,
+    batch_sharding,
+    create_mesh,
+    distributed_init,
+    replicated,
+)
+from sav_tpu.parallel.sharding import (
+    DEFAULT_TP_RULES,
+    param_path_specs,
+    param_shardings,
+    shard_params,
+)
+
+__all__ = [
+    "DATA_AXIS",
+    "MODEL_AXIS",
+    "SEQ_AXIS",
+    "batch_sharding",
+    "create_mesh",
+    "distributed_init",
+    "replicated",
+    "DEFAULT_TP_RULES",
+    "param_path_specs",
+    "param_shardings",
+    "shard_params",
+]

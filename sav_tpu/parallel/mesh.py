@@ -1,0 +1,102 @@
+"""Device mesh construction and distributed bring-up.
+
+The TPU-native replacement for the reference's pmap data parallelism
+(/root/reference/train.py:228-231, experiments/base.py:64-68): one
+``jax.sharding.Mesh`` over all devices; pjit/NamedSharding make XLA's
+partitioner emit the gradient AllReduce over ICI/DCN (the reference wrote
+``lax.pmean`` by hand — train.py:96). Multi-host bring-up goes through
+``jax.distributed.initialize`` once per process.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+# Canonical axis names. data = batch (DP), model = tensor parallel (TP),
+# seq = sequence/context parallel (ring attention).
+DATA_AXIS = "data"
+MODEL_AXIS = "model"
+SEQ_AXIS = "seq"
+
+_distributed_initialized = False
+
+
+def distributed_init(
+    coordinator_address: Optional[str] = None,
+    num_processes: Optional[int] = None,
+    process_id: Optional[int] = None,
+) -> None:
+    """Initialize multi-host JAX exactly once (no-op on single process).
+
+    Replaces the reference's implicit jaxline/TPU-VM host coordination
+    (SURVEY.md §2.7). MUST be the first JAX call in the process: any
+    backend-touching API (``jax.devices``, ``jax.process_count``, ...)
+    before this makes ``jax.distributed.initialize`` raise. With no
+    arguments, initialization is attempted only when the environment
+    advertises a coordinator (TPU pod / SLURM); plain single-process runs
+    fall through untouched.
+    """
+    global _distributed_initialized
+    if _distributed_initialized:
+        return
+    import os
+
+    env_hints = (
+        "COORDINATOR_ADDRESS",
+        "JAX_COORDINATOR_ADDRESS",
+        "MEGASCALE_COORDINATOR_ADDRESS",
+        "SLURM_JOB_ID",
+    )
+    explicit = coordinator_address is not None
+    if explicit or any(os.environ.get(k) for k in env_hints):
+        jax.distributed.initialize(coordinator_address, num_processes, process_id)
+    _distributed_initialized = True
+
+
+def create_mesh(
+    axis_sizes: Optional[dict[str, int]] = None,
+    *,
+    devices: Optional[Sequence[jax.Device]] = None,
+) -> Mesh:
+    """Build a mesh over ``devices`` (default: all).
+
+    ``axis_sizes`` maps axis name → size; a single ``-1`` entry absorbs the
+    remaining devices. Default: everything on the ``data`` axis.
+
+    Examples::
+
+      create_mesh()                              # 1-D DP mesh
+      create_mesh({"data": -1, "model": 2})      # DP × TP
+      create_mesh({"data": 1, "seq": 8})         # sequence-parallel ring
+    """
+    devices = list(devices if devices is not None else jax.devices())
+    n = len(devices)
+    if not axis_sizes:
+        axis_sizes = {DATA_AXIS: n}
+    names = tuple(axis_sizes)
+    sizes = list(axis_sizes.values())
+    wild = [i for i, s in enumerate(sizes) if s == -1]
+    if len(wild) > 1:
+        raise ValueError("at most one axis may be -1")
+    if wild:
+        known = int(np.prod([s for s in sizes if s != -1])) if len(sizes) > 1 else 1
+        if n % known:
+            raise ValueError(f"{n} devices not divisible by {known}")
+        sizes[wild[0]] = n // known
+    if int(np.prod(sizes)) != n:
+        raise ValueError(f"mesh {dict(zip(names, sizes))} != {n} devices")
+    dev_array = np.asarray(devices).reshape(sizes)
+    return Mesh(dev_array, names)
+
+
+def batch_sharding(mesh: Mesh) -> NamedSharding:
+    """Shard the leading (batch) dim over the data axis, replicate the rest."""
+    return NamedSharding(mesh, P(DATA_AXIS))
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())

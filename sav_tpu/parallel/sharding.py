@@ -1,0 +1,82 @@
+"""Parameter sharding rules (tensor parallelism without touching modules).
+
+The reference had DP only (SURVEY.md §2.7). Here TP is a first-class option:
+instead of annotating every module with ``with_partitioning``, we
+pattern-match flattened parameter paths against regex rules and build
+``NamedSharding`` trees. Under ``jax.jit`` the partitioner propagates the
+resulting layouts through the computation and inserts the right collectives
+over ICI.
+
+Default transformer TP layout (Megatron-style, over ``model`` axis):
+  - Q/K/V projections ``(in, heads, head_ch)`` → heads sharded,
+  - output merge ``(heads, head_ch, out)``     → heads sharded (row-parallel),
+  - MLP fc1 ``(in, hidden)``  → hidden sharded (column-parallel),
+  - MLP fc2 ``(hidden, out)`` → hidden sharded (row-parallel),
+  - everything else replicated.
+The pairing means each attention/MLP block needs exactly one AllReduce on its
+output — the layout the scaling-book recipe prescribes.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Any
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from sav_tpu.parallel.mesh import MODEL_AXIS
+
+# (path regex, partition spec builder taking the param ndim)
+DEFAULT_TP_RULES: list[tuple[str, Any]] = [
+    (r"to_q/kernel$", P(None, MODEL_AXIS, None)),
+    (r"to_k/kernel$", P(None, MODEL_AXIS, None)),
+    (r"to_v/kernel$", P(None, MODEL_AXIS, None)),
+    (r"to_(q|k|v)/bias$", P(MODEL_AXIS, None)),
+    (r"to_out/kernel$", P(MODEL_AXIS, None, None)),
+    (r"(fc1|expand)/kernel$", P(None, MODEL_AXIS)),
+    (r"(fc1|expand)/bias$", P(MODEL_AXIS)),
+    (r"(fc2|project)/kernel$", P(MODEL_AXIS, None)),
+]
+
+
+def param_path_specs(
+    params: Any, rules: list[tuple[str, Any]] | None = None
+) -> Any:
+    """Tree of ``PartitionSpec`` matching ``params``, from path-regex rules."""
+    rules = DEFAULT_TP_RULES if rules is None else rules
+    flat = jax.tree_util.tree_flatten_with_path(params)[0]
+
+    def spec_for(path, leaf):
+        path_str = "/".join(
+            k.key if hasattr(k, "key") else str(k) for k in path
+        )
+        for pattern, spec in rules:
+            if re.search(pattern, path_str) and len(spec) <= leaf.ndim:
+                return spec
+        return P()
+
+    specs = [spec_for(path, leaf) for path, leaf in flat]
+    treedef = jax.tree_util.tree_structure(params)
+    return jax.tree_util.tree_unflatten(treedef, specs)
+
+
+def param_shardings(
+    params: Any, mesh: Mesh, rules: list[tuple[str, Any]] | None = None
+) -> Any:
+    """Tree of ``NamedSharding`` for ``params``.
+
+    With no ``model`` axis in the mesh (pure DP) the *default* rules are
+    skipped (everything replicates). Caller-supplied rules are always
+    honored — they may target other mesh axes (e.g. ``seq``).
+    """
+    if rules is None:
+        rules = DEFAULT_TP_RULES if MODEL_AXIS in mesh.axis_names else []
+    specs = param_path_specs(params, rules)
+    return jax.tree.map(lambda s: NamedSharding(mesh, s), specs)
+
+
+def shard_params(params: Any, mesh: Mesh, rules=None) -> Any:
+    """Place a parameter tree onto the mesh according to the rules."""
+    shardings = param_shardings(params, mesh, rules)
+    return jax.tree.map(jax.device_put, params, shardings)

@@ -1,0 +1,19 @@
+from sav_tpu.train.checkpoint import Checkpointer
+from sav_tpu.train.config import TrainConfig
+from sav_tpu.train.optimizer import (
+    make_optimizer,
+    warmup_cosine_schedule,
+    weight_decay_mask,
+)
+from sav_tpu.train.state import TrainState
+from sav_tpu.train.trainer import Trainer
+
+__all__ = [
+    "Checkpointer",
+    "TrainConfig",
+    "TrainState",
+    "Trainer",
+    "make_optimizer",
+    "warmup_cosine_schedule",
+    "weight_decay_mask",
+]

@@ -1,0 +1,71 @@
+"""Typed training configuration.
+
+Replaces the reference's three disjoint config systems (click flags,
+jaxline ml_collections dicts, and reflection-resolved optimizer names —
+SURVEY.md §5 'Config / flag system') with one dataclass that serializes to
+JSON next to the checkpoints. Defaults mirror the reference recipe
+(/root/reference/train.py:130-220: 300 epochs, lr 5e-4 × bs/512, 5-epoch
+warmup cosine, label smoothing 0.1, AdamW-style weight decay).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+from typing import Optional
+
+
+@dataclasses.dataclass
+class TrainConfig:
+    # Model
+    model_name: str = "deit_s_patch16"
+    num_classes: int = 1000
+    image_size: int = 224
+    compute_dtype: str = "bfloat16"
+    attention_backend: Optional[str] = None  # None=auto | 'xla' | 'pallas'
+
+    # Data
+    global_batch_size: int = 1024
+    num_train_images: int = 1_281_167  # ImageNet-1k train
+    augment: str = "cutmix_mixup_randaugment_405"
+    transpose_images: bool = True  # HWCN double-transpose trick
+
+    # Optimization
+    num_epochs: int = 300
+    base_lr: float = 5e-4  # scaled by global_batch/512 (train.py:214)
+    lr_scaling_divisor: int = 512
+    end_lr: float = 1e-5
+    warmup_epochs: int = 5
+    weight_decay: float = 0.05
+    clip_grad_norm: Optional[float] = 1.0
+    label_smoothing: float = 0.1
+    seed: int = 42
+
+    # Mesh: axis name -> size (-1 absorbs remaining devices)
+    mesh_axes: Optional[dict] = None
+
+    # Logging / checkpointing
+    eval_every_epochs: int = 5
+    checkpoint_every_epochs: int = 10
+    checkpoint_dir: Optional[str] = None
+    checkpoint_keep: int = 3
+    log_every_steps: int = 100
+
+    @property
+    def steps_per_epoch(self) -> int:
+        return self.num_train_images // self.global_batch_size
+
+    @property
+    def total_steps(self) -> int:
+        return self.steps_per_epoch * self.num_epochs
+
+    @property
+    def learning_rate(self) -> float:
+        return self.base_lr * self.global_batch_size / self.lr_scaling_divisor
+
+    def to_json(self) -> str:
+        return json.dumps(dataclasses.asdict(self), indent=2)
+
+    @classmethod
+    def from_json(cls, text: str) -> "TrainConfig":
+        return cls(**json.loads(text))

@@ -1,0 +1,68 @@
+"""Schedules and the masked AdamW optimizer.
+
+Reference behavior rebuilt: warmup-cosine schedule (train.py:215-220) and the
+jaxline per-group optimizer that applied weight decay to weights but not
+biases (experiments/base.py:84-104) — expressed here as a single
+``optax.adamw`` with a mask over parameter paths instead of two reflected
+optimizers, plus global-norm clipping (train.py:25).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import jax
+import optax
+
+
+def warmup_cosine_schedule(
+    learning_rate: float,
+    *,
+    steps_per_epoch: int,
+    warmup_epochs: int,
+    num_epochs: int,
+    end_lr: float = 1e-5,
+) -> optax.Schedule:
+    return optax.warmup_cosine_decay_schedule(
+        init_value=0.0,
+        peak_value=learning_rate,
+        warmup_steps=max(1, warmup_epochs * steps_per_epoch),
+        decay_steps=max(2, num_epochs * steps_per_epoch),
+        end_value=end_lr,
+    )
+
+
+def weight_decay_mask(params: Any) -> Any:
+    """True (decay) for rank≥2 kernels; False for biases, norm scales,
+    position tables, CLS tokens, LayerScale — the reference's weight/bias
+    split (base.py:95-103) generalized by rank + name."""
+    flat = jax.tree_util.tree_flatten_with_path(params)[0]
+
+    def decays(path, leaf):
+        path_str = "/".join(k.key if hasattr(k, "key") else str(k) for k in path)
+        if leaf.ndim < 2:
+            return False
+        no_decay_names = ("pos_embed", "cls", "rel_emb_h", "rel_emb_w")
+        return not any(n in path_str for n in no_decay_names)
+
+    leaves = [decays(p, l) for p, l in flat]
+    return jax.tree_util.tree_unflatten(jax.tree_util.tree_structure(params), leaves)
+
+
+def make_optimizer(
+    schedule: optax.Schedule,
+    *,
+    weight_decay: float = 0.05,
+    clip_grad_norm: Optional[float] = 1.0,
+) -> optax.GradientTransformation:
+    chain = []
+    if clip_grad_norm is not None:
+        chain.append(optax.clip_by_global_norm(clip_grad_norm))
+    chain.append(
+        optax.adamw(
+            learning_rate=schedule,
+            weight_decay=weight_decay,
+            mask=weight_decay_mask,
+        )
+    )
+    return optax.chain(*chain)

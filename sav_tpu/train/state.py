@@ -1,0 +1,30 @@
+"""Train state pytree.
+
+One state for both stateless and BatchNorm models — collapsing the
+reference's duplicated ``experiments/base.py`` / ``base_with_state.py``
+trainers (SURVEY.md §2.6): ``batch_stats`` is just an (possibly empty)
+collection threaded through the step.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import flax.struct
+import jax.numpy as jnp
+
+
+class TrainState(flax.struct.PyTreeNode):
+    step: Any
+    params: Any
+    opt_state: Any
+    batch_stats: Any  # {} for models without BatchNorm
+
+    @classmethod
+    def create(cls, params, opt_state, batch_stats=None):
+        return cls(
+            step=jnp.zeros((), jnp.int32),
+            params=params,
+            opt_state=opt_state,
+            batch_stats=batch_stats if batch_stats is not None else {},
+        )

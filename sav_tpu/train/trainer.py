@@ -1,0 +1,338 @@
+"""pjit SPMD trainer.
+
+The TPU-native replacement for both reference trainers (the pmap click CLI,
+/root/reference/train.py:191-255, and the jaxline Experiment,
+experiments/base.py:30-239): one jitted train step over a
+``jax.sharding.Mesh``. There are no hand-written ``psum``/``pmean`` calls —
+the batch is sharded over the ``data`` axis, parameters are replicated (or
+TP-sharded via :mod:`sav_tpu.parallel.sharding` rules), and XLA's
+partitioner emits the gradient AllReduce over ICI/DCN. One trainer covers
+both stateless and BatchNorm models (collapsing base.py/base_with_state.py),
+state is donated for in-place buffer reuse (base.py:64-68), logging happens
+on the host outside the compiled step (fixing train.py:102-107's
+wandb-inside-pmap tracer leak), and restore actually works (train.py never
+called it).
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Iterator, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from sav_tpu.models import create_model
+from sav_tpu.parallel.mesh import DATA_AXIS, create_mesh
+from sav_tpu.parallel.sharding import param_shardings
+from sav_tpu.train.checkpoint import Checkpointer
+from sav_tpu.train.config import TrainConfig
+from sav_tpu.train.optimizer import make_optimizer, warmup_cosine_schedule
+from sav_tpu.train.state import TrainState
+from sav_tpu.utils.metrics import cross_entropy, topk_correct
+
+
+class Trainer:
+    def __init__(
+        self,
+        config: TrainConfig,
+        *,
+        mesh=None,
+        model=None,
+        checkpointer: Optional[Checkpointer] = None,
+    ):
+        self.config = config
+        self.mesh = mesh if mesh is not None else create_mesh(config.mesh_axes)
+        self.compute_dtype = (
+            jnp.bfloat16 if config.compute_dtype == "bfloat16" else jnp.float32
+        )
+        self.model = (
+            model
+            if model is not None
+            else create_model(
+                config.model_name,
+                num_classes=config.num_classes,
+                dtype=self.compute_dtype,
+                backend=config.attention_backend,
+            )
+        )
+        self.schedule = warmup_cosine_schedule(
+            config.learning_rate,
+            steps_per_epoch=config.steps_per_epoch,
+            warmup_epochs=config.warmup_epochs,
+            num_epochs=config.num_epochs,
+            end_lr=config.end_lr,
+        )
+        self.tx = make_optimizer(
+            self.schedule,
+            weight_decay=config.weight_decay,
+            clip_grad_norm=config.clip_grad_norm,
+        )
+        self.checkpointer = checkpointer
+        if checkpointer is None and config.checkpoint_dir:
+            self.checkpointer = Checkpointer(
+                config.checkpoint_dir, keep=config.checkpoint_keep
+            )
+        self._train_step = jax.jit(self._train_step_impl, donate_argnums=(0,))
+        self._train_many = jax.jit(self._train_many_impl, donate_argnums=(0,))
+        self._eval_step = jax.jit(self._eval_step_impl)
+
+    # ------------------------------------------------------------------ init
+
+    def _dummy_input(self) -> jax.Array:
+        s = self.config.image_size
+        return jnp.zeros((2, s, s, 3), self.compute_dtype)
+
+    def init_state(self, seed: Optional[int] = None) -> TrainState:
+        """Build a sharded TrainState directly on the mesh.
+
+        The state is created *inside* jit with explicit out_shardings, so
+        large models materialize sharded — parameters never pass through a
+        single host buffer.
+        """
+        rng = jax.random.PRNGKey(self.config.seed if seed is None else seed)
+        dummy = self._dummy_input()
+
+        def init_fn(rng):
+            variables = self.model.init({"params": rng}, dummy, is_training=False)
+            variables = dict(variables)
+            params = variables.pop("params")
+            batch_stats = variables.pop("batch_stats", {})
+            opt_state = self.tx.init(params)
+            return TrainState.create(params, opt_state, batch_stats)
+
+        abstract = jax.eval_shape(init_fn, rng)
+        # Rules match on path *suffixes*, so optimizer-state mirrors of the
+        # param tree (mu/nu) pick up the same TP shardings automatically.
+        shardings = param_shardings(abstract, self.mesh)
+        state = jax.jit(init_fn, out_shardings=shardings)(rng)
+        return state
+
+    def restore_or_init(self) -> TrainState:
+        state = self.init_state()
+        if self.checkpointer is not None:
+            restored = self.checkpointer.restore_latest(state)
+            if restored is not None:
+                return restored
+        return state
+
+    # ----------------------------------------------------------------- steps
+
+    def _prep_images(self, images: jax.Array) -> jax.Array:
+        if self.config.transpose_images and images.ndim == 4:
+            # HWCN → NHWC (the reference's double-transpose trick lands the
+            # device-side transpose here, train.py:80).
+            images = jnp.transpose(images, (3, 0, 1, 2))
+        return images.astype(self.compute_dtype)
+
+    def _label_probs(self, batch: dict) -> jax.Array:
+        labels = batch["labels"]
+        onehot = jax.nn.one_hot(labels, self.config.num_classes, dtype=jnp.float32)
+        if "mix_labels" in batch:
+            ratio = batch["ratio"].astype(jnp.float32)[:, None]
+            mix = jax.nn.one_hot(
+                batch["mix_labels"], self.config.num_classes, dtype=jnp.float32
+            )
+            onehot = ratio * onehot + (1.0 - ratio) * mix
+        if self.config.label_smoothing > 0.0:
+            onehot = optax.smooth_labels(onehot, self.config.label_smoothing)
+        return onehot
+
+    def _train_step_impl(self, state: TrainState, batch: dict, rng: jax.Array):
+        step_rng = jax.random.fold_in(rng, state.step)
+        dropout_rng, sd_rng = jax.random.split(step_rng)
+        images = self._prep_images(batch["images"])
+        label_probs = self._label_probs(batch)
+        has_bn = bool(state.batch_stats)
+
+        def loss_fn(params):
+            variables = {"params": params}
+            if has_bn:
+                variables["batch_stats"] = state.batch_stats
+            out = self.model.apply(
+                variables,
+                images,
+                is_training=True,
+                rngs={"dropout": dropout_rng, "stochastic_depth": sd_rng},
+                mutable=["batch_stats"] if has_bn else False,
+            )
+            if has_bn:
+                logits, new_vars = out
+                new_batch_stats = new_vars["batch_stats"]
+            else:
+                logits, new_batch_stats = out, state.batch_stats
+            loss = cross_entropy(logits, label_probs)
+            return loss, (logits, new_batch_stats)
+
+        (loss, (logits, new_batch_stats)), grads = jax.value_and_grad(
+            loss_fn, has_aux=True
+        )(state.params)
+        updates, new_opt_state = self.tx.update(grads, state.opt_state, state.params)
+        new_params = optax.apply_updates(state.params, updates)
+        new_state = state.replace(
+            step=state.step + 1,
+            params=new_params,
+            opt_state=new_opt_state,
+            batch_stats=new_batch_stats,
+        )
+        acc = topk_correct(logits.astype(jnp.float32), batch["labels"])
+        metrics = {
+            "loss": loss,
+            "top_1_acc": jnp.mean(acc["top_1_acc"]),
+            "top_5_acc": jnp.mean(acc["top_5_acc"]),
+            "learning_rate": self.schedule(state.step),
+            "grad_norm": optax.global_norm(grads),
+        }
+        return new_state, metrics
+
+    def _train_many_impl(self, state: TrainState, batches: dict, rng: jax.Array):
+        """K train steps in one compiled program via ``lax.scan``.
+
+        ``batches`` leaves carry a leading steps axis ``[K, ...]``. Keeping
+        the step loop on-device removes the per-step host dispatch round
+        trip — on TPU pods that overhead is µs, but the pattern also hides
+        host jitter and lets XLA overlap the inter-step boundary. Metrics
+        come back stacked ``[K]``.
+        """
+
+        def body(state, batch):
+            return self._train_step_impl(state, batch, rng)
+
+        return jax.lax.scan(body, state, batches)
+
+    def train_many_steps(self, state: TrainState, batches: dict, rng: jax.Array):
+        """Run ``K`` steps fused on-device; see ``_train_many_impl``."""
+
+        def sharding_for(key, leaf):
+            if key == "images" and self.config.transpose_images and leaf.ndim == 5:
+                return NamedSharding(self.mesh, P(None, None, None, None, DATA_AXIS))
+            return NamedSharding(self.mesh, P(None, DATA_AXIS))
+
+        placed = {k: jax.device_put(v, sharding_for(k, v)) for k, v in batches.items()}
+        return self._train_many(state, placed, rng)
+
+    def _eval_step_impl(self, state: TrainState, batch: dict):
+        images = self._prep_images(batch["images"])
+        variables = {"params": state.params}
+        if state.batch_stats:
+            variables["batch_stats"] = state.batch_stats
+        logits = self.model.apply(variables, images, is_training=False)
+        logits = logits.astype(jnp.float32)
+        labels = batch["labels"]
+        onehot = jax.nn.one_hot(labels, self.config.num_classes, dtype=jnp.float32)
+        n = labels.shape[0]
+        acc = topk_correct(logits, labels)
+        return {
+            "loss_sum": cross_entropy(logits, onehot) * n,
+            "top_1_sum": jnp.sum(acc["top_1_acc"]),
+            "top_5_sum": jnp.sum(acc["top_5_acc"]),
+            "count": jnp.asarray(n, jnp.float32),
+        }
+
+    # ------------------------------------------------------------- data flow
+
+    def shard_batch(self, batch: dict) -> dict:
+        """Place a host batch onto the mesh, batch dim over the data axis."""
+
+        def sharding_for(key, leaf):
+            if key == "images" and self.config.transpose_images and leaf.ndim == 4:
+                return NamedSharding(self.mesh, P(None, None, None, DATA_AXIS))
+            return NamedSharding(self.mesh, P(DATA_AXIS))
+
+        return {
+            k: jax.device_put(v, sharding_for(k, v)) for k, v in batch.items()
+        }
+
+    # ------------------------------------------------------------------ loop
+
+    def train_step(self, state: TrainState, batch: dict, rng: jax.Array):
+        return self._train_step(state, self.shard_batch(batch), rng)
+
+    def eval_step(self, state: TrainState, batch: dict):
+        return self._eval_step(state, self.shard_batch(batch))
+
+    def evaluate(self, state: TrainState, eval_iter: Iterator[dict]) -> dict:
+        totals: dict[str, float] = {}
+        for batch in eval_iter:
+            sums = jax.device_get(self.eval_step(state, batch))
+            for k, v in sums.items():
+                totals[k] = totals.get(k, 0.0) + float(v)
+        n = max(totals.get("count", 0.0), 1.0)
+        return {
+            "eval_loss": totals.get("loss_sum", 0.0) / n,
+            "eval_top_1_acc": totals.get("top_1_sum", 0.0) / n,
+            "eval_top_5_acc": totals.get("top_5_sum", 0.0) / n,
+            "eval_count": n,
+        }
+
+    def fit(
+        self,
+        train_iter: Iterator[dict],
+        *,
+        num_steps: Optional[int] = None,
+        eval_iter_fn=None,
+        state: Optional[TrainState] = None,
+        log_fn=None,
+    ) -> tuple[TrainState, list[dict]]:
+        """Run the training loop.
+
+        Args:
+          train_iter: yields batches (dicts with 'images', 'labels', optional
+            'mix_labels'/'ratio').
+          num_steps: total steps (default: config.total_steps).
+          eval_iter_fn: zero-arg callable returning a fresh eval iterator
+            (fixes the reference's exhausted-generator eval bug,
+            train.py:239-250 / SURVEY.md §2.9 #21).
+          log_fn: callable(dict) for metrics (host-side, outside jit).
+        """
+        cfg = self.config
+        num_steps = num_steps if num_steps is not None else cfg.total_steps
+        state = state if state is not None else self.restore_or_init()
+        rng = jax.random.PRNGKey(cfg.seed + 1)
+        history: list[dict] = []
+        start_step = int(jax.device_get(state.step))
+        t_last = time.time()
+        last_logged_step = start_step
+        last_saved_step = None
+        for step, batch in zip(range(start_step, num_steps), train_iter):
+            state, metrics = self.train_step(state, batch, rng)
+            if (step + 1) % cfg.log_every_steps == 0 or step + 1 == num_steps:
+                m = {k: float(v) for k, v in jax.device_get(metrics).items()}
+                now = time.time()
+                m["step"] = step + 1
+                steps_since = step + 1 - last_logged_step
+                m["images_per_sec"] = (
+                    cfg.global_batch_size * steps_since / max(now - t_last, 1e-9)
+                )
+                t_last = now
+                last_logged_step = step + 1
+                history.append(m)
+                if log_fn is not None:
+                    log_fn(m)
+            epoch_done = (step + 1) % cfg.steps_per_epoch == 0
+            if epoch_done:
+                epoch = (step + 1) // cfg.steps_per_epoch
+                if eval_iter_fn is not None and epoch % cfg.eval_every_epochs == 0:
+                    em = self.evaluate(state, eval_iter_fn())
+                    em["step"] = step + 1
+                    history.append(em)
+                    if log_fn is not None:
+                        log_fn(em)
+                if (
+                    self.checkpointer is not None
+                    and epoch % cfg.checkpoint_every_epochs == 0
+                ):
+                    self.checkpointer.save(step + 1, state)
+                    last_saved_step = step + 1
+                # Reset the throughput window so eval/checkpoint wall time
+                # doesn't deflate the next logged images_per_sec.
+                t_last = time.time()
+                last_logged_step = step + 1
+        if self.checkpointer is not None:
+            if last_saved_step != num_steps:
+                self.checkpointer.save(num_steps, state)
+            self.checkpointer.wait()
+        return state, history

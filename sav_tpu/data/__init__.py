@@ -1,3 +1,21 @@
+from sav_tpu.data.augment_spec import AugmentSpec, parse_augment_spec
 from sav_tpu.data.synthetic import fake_data_iterator, synthetic_data_iterator
 
-__all__ = ["fake_data_iterator", "synthetic_data_iterator"]
+__all__ = [
+    "AugmentSpec",
+    "parse_augment_spec",
+    "fake_data_iterator",
+    "synthetic_data_iterator",
+    "load",
+    "Split",
+]
+
+
+def __getattr__(name):
+    # pipeline (and its TF import) loads lazily so fake/synthetic paths work
+    # in TF-free contexts.
+    if name in ("load", "Split"):
+        from sav_tpu.data import pipeline
+
+        return getattr(pipeline, name)
+    raise AttributeError(name)

@@ -1,0 +1,153 @@
+"""RandAugment and AutoAugment-v0 policies over :mod:`sav_tpu.data.image_ops`.
+
+The reference shipped RandAugment only — its pipeline referenced
+``distort_image_with_autoaugment`` that was never defined
+(/root/reference/input_pipeline.py:428, SURVEY.md §2.9 #10). Both paths work
+here. Op selection uses ``tf.switch_case`` (one branch table) instead of the
+reference's nested ``tf.cond`` ladder (autoaugment.py:543-564).
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+import tensorflow as tf
+
+from sav_tpu.data import image_ops as ops
+
+_MAX_LEVEL = 10.0
+
+
+def _mag(level: float, maxval: float) -> float:
+    return level / _MAX_LEVEL * maxval
+
+
+def _signed(value):
+    sign = tf.cast(tf.random.uniform([], 0, 2, tf.int32) * 2 - 1, tf.float32)
+    return tf.cast(value, tf.float32) * sign
+
+
+# name -> callable(image, level) applying the op at that magnitude.
+def _op_table(cutout_const: int, translate_const: int) -> dict[str, Callable]:
+    return {
+        "AutoContrast": lambda im, lv: ops.autocontrast(im),
+        "Equalize": lambda im, lv: ops.equalize(im),
+        "Invert": lambda im, lv: ops.invert(im),
+        "Rotate": lambda im, lv: ops.rotate(im, _signed(_mag(lv, 30.0))),
+        # Posterize/Solarize keep the published AA magnitude mapping the
+        # policies were tuned against (bits = lv/10*4 kept; threshold =
+        # lv/10*256 — higher level is *weaker* solarize), matching
+        # /root/reference/autoaugment.py:455-467.
+        "Posterize": lambda im, lv: ops.posterize(im, int(_mag(lv, 4.0))),
+        "Solarize": lambda im, lv: ops.solarize(im, int(_mag(lv, 256.0))),
+        "SolarizeAdd": lambda im, lv: ops.solarize_add(im, int(_mag(lv, 110.0))),
+        "Color": lambda im, lv: ops.color(im, 1.0 + _signed(_mag(lv, 0.9))),
+        "Contrast": lambda im, lv: ops.contrast(im, 1.0 + _signed(_mag(lv, 0.9))),
+        "Brightness": lambda im, lv: ops.brightness(im, 1.0 + _signed(_mag(lv, 0.9))),
+        "Sharpness": lambda im, lv: ops.sharpness(im, 1.0 + _signed(_mag(lv, 0.9))),
+        "ShearX": lambda im, lv: ops.shear_x(im, _signed(_mag(lv, 0.3))),
+        "ShearY": lambda im, lv: ops.shear_y(im, _signed(_mag(lv, 0.3))),
+        "TranslateX": lambda im, lv: ops.translate_x(
+            im, _signed(_mag(lv, float(translate_const)))
+        ),
+        "TranslateY": lambda im, lv: ops.translate_y(
+            im, _signed(_mag(lv, float(translate_const)))
+        ),
+        "Cutout": lambda im, lv: ops.cutout(im, int(_mag(lv, float(cutout_const)))),
+    }
+
+
+_RANDAUG_OPS = [
+    "AutoContrast", "Equalize", "Invert", "Rotate", "Posterize", "Solarize",
+    "Color", "Contrast", "Brightness", "Sharpness", "ShearX", "ShearY",
+    "TranslateX", "TranslateY", "Cutout", "SolarizeAdd",
+]
+
+
+def distort_image_with_randaugment(
+    image: tf.Tensor,
+    num_layers: int,
+    magnitude: int,
+    *,
+    cutout_const: int = 40,
+    translate_const: int = 100,
+) -> tf.Tensor:
+    """RandAugment: ``num_layers`` uniformly-chosen ops at fixed magnitude,
+    each applied with probability ~U[0.2, 0.8] (reference semantics,
+    autoaugment.py:519-565)."""
+    table = _op_table(cutout_const, translate_const)
+    branches = [
+        (lambda name: (lambda im: table[name](im, float(magnitude))))(n)
+        for n in _RANDAUG_OPS
+    ]
+    for _ in range(num_layers):
+        op_idx = tf.random.uniform([], 0, len(branches), tf.int32)
+        prob = tf.random.uniform([], 0.2, 0.8)
+        should = tf.random.uniform([]) < prob
+        image = tf.cond(
+            should,
+            lambda: tf.switch_case(op_idx, [
+                (lambda b: (lambda: b(image)))(branch) for branch in branches
+            ]),
+            lambda: image,
+        )
+    return image
+
+
+# AutoAugment ImageNet policy v0 (25 sub-policies of two (op, prob, level)
+# steps — the policy published with the AutoAugment paper).
+_POLICY_V0 = [
+    [("Equalize", 0.8, 1), ("ShearY", 0.8, 4)],
+    [("Color", 0.4, 9), ("Equalize", 0.6, 3)],
+    [("Color", 0.4, 1), ("Rotate", 0.6, 8)],
+    [("Solarize", 0.8, 3), ("Equalize", 0.4, 7)],
+    [("Solarize", 0.4, 2), ("Solarize", 0.6, 2)],
+    [("Color", 0.2, 0), ("Equalize", 0.8, 8)],
+    [("Equalize", 0.4, 8), ("SolarizeAdd", 0.8, 3)],
+    [("ShearX", 0.2, 9), ("Rotate", 0.6, 8)],
+    [("Color", 0.6, 1), ("Equalize", 1.0, 2)],
+    [("Invert", 0.4, 9), ("Rotate", 0.6, 0)],
+    [("Equalize", 1.0, 9), ("ShearY", 0.6, 3)],
+    [("Color", 0.4, 7), ("Equalize", 0.6, 0)],
+    [("Posterize", 0.4, 6), ("AutoContrast", 0.4, 7)],
+    [("Solarize", 0.6, 8), ("Color", 0.6, 9)],
+    [("Solarize", 0.2, 4), ("Rotate", 0.8, 9)],
+    [("Rotate", 1.0, 7), ("TranslateY", 0.8, 9)],
+    [("ShearX", 0.0, 0), ("Solarize", 0.8, 4)],
+    [("ShearY", 0.8, 0), ("Color", 0.6, 4)],
+    [("Color", 1.0, 0), ("Rotate", 0.6, 2)],
+    [("Equalize", 0.8, 4), ("Equalize", 0.0, 8)],
+    [("Equalize", 1.0, 4), ("AutoContrast", 0.6, 2)],
+    [("ShearY", 0.4, 7), ("SolarizeAdd", 0.6, 7)],
+    [("Posterize", 0.8, 2), ("Solarize", 0.6, 10)],
+    [("Solarize", 0.6, 8), ("Equalize", 0.6, 1)],
+    [("Color", 0.8, 6), ("Rotate", 0.4, 5)],
+]
+
+
+def distort_image_with_autoaugment(
+    image: tf.Tensor,
+    *,
+    cutout_const: int = 100,
+    translate_const: int = 250,
+) -> tf.Tensor:
+    """Apply one random AutoAugment-v0 sub-policy (the working version of the
+    path the reference declared but never shipped)."""
+    table = _op_table(cutout_const, translate_const)
+
+    def apply_subpolicy(sub):
+        def fn():
+            im = image
+            for name, prob, level in sub:
+                should = tf.random.uniform([]) < prob
+                im = tf.cond(
+                    should,
+                    (lambda im=im, name=name, level=level: table[name](im, float(level))),
+                    (lambda im=im: im),
+                )
+            return im
+
+        return fn
+
+    idx = tf.random.uniform([], 0, len(_POLICY_V0), tf.int32)
+    return tf.switch_case(idx, [apply_subpolicy(sub) for sub in _POLICY_V0])

@@ -1,0 +1,179 @@
+"""TF uint8 image augmentation ops.
+
+Capability parity with the reference's 16-op zoo
+(/root/reference/autoaugment.py:36-392) rebuilt on modern TF primitives:
+geometric ops go through one affine helper on
+``tf.raw_ops.ImageProjectiveTransformV3`` (native ``fill_value`` — no
+wrap/unwrap alpha-channel trick needed), photometric ops are small uint8
+kernels. All ops take/return ``[H, W, 3] uint8`` tensors.
+"""
+
+from __future__ import annotations
+
+import math
+
+import tensorflow as tf
+
+_GRAY = tf.constant([128] * 3, tf.float32)
+
+
+def blend(image_a: tf.Tensor, image_b: tf.Tensor, factor) -> tf.Tensor:
+    """``a + factor * (b - a)``, clipped to uint8 range. factor may exceed 1."""
+    a = tf.cast(image_a, tf.float32)
+    b = tf.cast(image_b, tf.float32)
+    out = a + tf.cast(factor, tf.float32) * (b - a)
+    return tf.cast(tf.clip_by_value(out, 0.0, 255.0), tf.uint8)
+
+
+# ---------------------------------------------------------------- geometric
+
+
+def _affine(image: tf.Tensor, transform, fill: int = 128) -> tf.Tensor:
+    """Apply a single projective transform (8-vector) with constant fill."""
+    out = tf.raw_ops.ImageProjectiveTransformV3(
+        images=tf.cast(image, tf.float32)[None],
+        transforms=tf.convert_to_tensor([transform], tf.float32),
+        output_shape=tf.shape(image)[:2],
+        fill_value=float(fill),
+        fill_mode="CONSTANT",
+        interpolation="NEAREST",
+    )[0]
+    return tf.cast(tf.clip_by_value(out, 0.0, 255.0), tf.uint8)
+
+
+def rotate(image: tf.Tensor, degrees: float, fill: int = 128) -> tf.Tensor:
+    radians = degrees * math.pi / 180.0
+    c, s = tf.cos(radians), tf.sin(radians)
+    h = tf.cast(tf.shape(image)[0], tf.float32)
+    w = tf.cast(tf.shape(image)[1], tf.float32)
+    cx, cy = (w - 1.0) / 2.0, (h - 1.0) / 2.0
+    # Rotation about the image center (output→input mapping).
+    tx = cx - c * cx + s * cy
+    ty = cy - s * cx - c * cy
+    return _affine(image, [c, -s, tx, s, c, ty, 0.0, 0.0], fill)
+
+
+def shear_x(image: tf.Tensor, level: float, fill: int = 128) -> tf.Tensor:
+    return _affine(image, [1.0, level, 0.0, 0.0, 1.0, 0.0, 0.0, 0.0], fill)
+
+
+def shear_y(image: tf.Tensor, level: float, fill: int = 128) -> tf.Tensor:
+    return _affine(image, [1.0, 0.0, 0.0, level, 1.0, 0.0, 0.0, 0.0], fill)
+
+
+def translate_x(image: tf.Tensor, pixels: float, fill: int = 128) -> tf.Tensor:
+    return _affine(image, [1.0, 0.0, -pixels, 0.0, 1.0, 0.0, 0.0, 0.0], fill)
+
+
+def translate_y(image: tf.Tensor, pixels: float, fill: int = 128) -> tf.Tensor:
+    return _affine(image, [1.0, 0.0, 0.0, 0.0, 1.0, -pixels, 0.0, 0.0], fill)
+
+
+# -------------------------------------------------------------- photometric
+
+
+def invert(image: tf.Tensor) -> tf.Tensor:
+    return 255 - image
+
+
+def posterize(image: tf.Tensor, bits: int) -> tf.Tensor:
+    shift = tf.cast(8 - bits, image.dtype)
+    return tf.bitwise.left_shift(tf.bitwise.right_shift(image, shift), shift)
+
+
+def solarize(image: tf.Tensor, threshold: int = 128) -> tf.Tensor:
+    return tf.where(image < tf.cast(threshold, image.dtype), image, 255 - image)
+
+
+def solarize_add(image: tf.Tensor, addition: int, threshold: int = 128) -> tf.Tensor:
+    added = tf.cast(
+        tf.clip_by_value(tf.cast(image, tf.int32) + addition, 0, 255), image.dtype
+    )
+    return tf.where(image < tf.cast(threshold, image.dtype), added, image)
+
+
+def color(image: tf.Tensor, factor: float) -> tf.Tensor:
+    gray = tf.image.grayscale_to_rgb(tf.image.rgb_to_grayscale(image))
+    return blend(gray, image, factor)
+
+
+def contrast(image: tf.Tensor, factor: float) -> tf.Tensor:
+    mean = tf.reduce_mean(tf.cast(tf.image.rgb_to_grayscale(image), tf.float32))
+    flat = tf.cast(tf.fill(tf.shape(image), 0), tf.float32) + mean
+    return blend(tf.cast(flat, tf.uint8), image, factor)
+
+
+def brightness(image: tf.Tensor, factor: float) -> tf.Tensor:
+    return blend(tf.zeros_like(image), image, factor)
+
+
+def autocontrast(image: tf.Tensor) -> tf.Tensor:
+    def per_channel(ch):
+        ch_f = tf.cast(ch, tf.float32)
+        lo = tf.reduce_min(ch_f)
+        hi = tf.reduce_max(ch_f)
+
+        def stretch():
+            scale = 255.0 / (hi - lo)
+            return tf.clip_by_value((ch_f - lo) * scale, 0.0, 255.0)
+
+        return tf.cast(tf.cond(hi > lo, stretch, lambda: ch_f), tf.uint8)
+
+    return tf.stack(
+        [per_channel(image[..., c]) for c in range(3)], axis=-1
+    )
+
+
+def equalize(image: tf.Tensor) -> tf.Tensor:
+    def per_channel(ch):
+        hist = tf.histogram_fixed_width(tf.cast(ch, tf.int32), [0, 255], nbins=256)
+        nonzero = tf.boolean_mask(hist, hist != 0)
+        step = (tf.reduce_sum(nonzero) - nonzero[-1]) // 255
+
+        def eq():
+            lut = (tf.cumsum(hist) + (step // 2)) // step
+            lut = tf.concat([[step // 2 // step], lut[:-1]], 0)
+            lut = tf.clip_by_value(lut, 0, 255)
+            return tf.gather(lut, tf.cast(ch, tf.int32))
+
+        return tf.cast(
+            tf.cond(step == 0, lambda: tf.cast(ch, tf.int32), eq), tf.uint8
+        )
+
+    return tf.stack([per_channel(image[..., c]) for c in range(3)], axis=-1)
+
+
+def sharpness(image: tf.Tensor, factor: float) -> tf.Tensor:
+    img = tf.cast(image, tf.float32)[None]
+    kernel = (
+        tf.constant([[1, 1, 1], [1, 5, 1], [1, 1, 1]], tf.float32, shape=[3, 3, 1, 1])
+        / 13.0
+    )
+    kernel = tf.tile(kernel, [1, 1, 3, 1])
+    smoothed = tf.nn.depthwise_conv2d(
+        img, kernel, strides=[1, 1, 1, 1], padding="VALID"
+    )
+    smoothed = tf.clip_by_value(smoothed, 0.0, 255.0)
+    # Keep original border (conv is VALID), smooth interior only.
+    smoothed = tf.pad(smoothed, [[0, 0], [1, 1], [1, 1], [0, 0]])
+    mask = tf.pad(
+        tf.ones_like(smoothed[:, 1:-1, 1:-1, :]), [[0, 0], [1, 1], [1, 1], [0, 0]]
+    )
+    smoothed = tf.where(mask == 1.0, smoothed, img)
+    return blend(tf.cast(smoothed[0], tf.uint8), image, factor)
+
+
+def cutout(image: tf.Tensor, pad_size: int, fill: int = 128) -> tf.Tensor:
+    """Zero out (to ``fill``) a random ``2*pad_size`` square."""
+    h = tf.shape(image)[0]
+    w = tf.shape(image)[1]
+    cy = tf.random.uniform([], 0, h, tf.int32)
+    cx = tf.random.uniform([], 0, w, tf.int32)
+    y0 = tf.maximum(cy - pad_size, 0)
+    y1 = tf.minimum(cy + pad_size, h)
+    x0 = tf.maximum(cx - pad_size, 0)
+    x1 = tf.minimum(cx + pad_size, w)
+    rows = tf.range(h)[:, None, None]
+    cols = tf.range(w)[None, :, None]
+    inside = (rows >= y0) & (rows < y1) & (cols >= x0) & (cols < x1)
+    return tf.where(inside, tf.cast(fill, image.dtype), image)

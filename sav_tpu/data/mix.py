@@ -1,0 +1,99 @@
+"""Batch-level CutMix / MixUp (TF graph ops).
+
+Capability parity with the reference's mix family
+(/root/reference/input_pipeline.py:248-350): CutMix rectangles with
+area-ratio labels, MixUp with Beta-sampled ratios, and the combined
+mixup-or-cutmix batch policy. Implementation differs deliberately: instead
+of splitting the batch in halves (reference ``my_cutmix``:285-299), each
+example mixes with its ``roll``-by-1 partner — every sample stays in the
+batch, which keeps the effective batch size and is the timm-standard
+formulation. Emits ``labels``, ``mix_labels`` and per-example ``ratio``;
+the trainer mixes one-hot targets accordingly
+(/root/reference/train.py:84-87 behavior).
+"""
+
+from __future__ import annotations
+
+import tensorflow as tf
+
+
+def _sample_beta(shape, alpha: float) -> tf.Tensor:
+    """Beta(alpha, alpha) via two Gammas (TF has no direct Beta sampler)."""
+    g1 = tf.random.gamma(shape, alpha)
+    g2 = tf.random.gamma(shape, alpha)
+    return g1 / (g1 + g2)
+
+
+def mixup(batch: dict, alpha: float = 0.2) -> dict:
+    """images ← r·x + (1-r)·roll(x); ratio r ~ Beta(alpha, alpha) per batch."""
+    images = tf.cast(batch["images"], tf.float32)
+    n = tf.shape(images)[0]
+    ratio = _sample_beta([], alpha)
+    mixed = ratio * images + (1.0 - ratio) * tf.roll(images, 1, axis=0)
+    return dict(
+        batch,
+        images=mixed,
+        mix_labels=tf.roll(batch["labels"], 1, axis=0),
+        ratio=tf.fill([n], tf.cast(ratio, tf.float32)),
+    )
+
+
+def _cutmix_box(height: int, width: int, alpha: float):
+    """Random box whose area fraction ≈ (1-λ), λ ~ Beta(alpha, alpha)."""
+    lam = _sample_beta([], alpha)
+    cut = tf.sqrt(1.0 - lam)
+    cut_h = tf.cast(cut * tf.cast(height, tf.float32), tf.int32)
+    cut_w = tf.cast(cut * tf.cast(width, tf.float32), tf.int32)
+    cy = tf.random.uniform([], 0, height, tf.int32)
+    cx = tf.random.uniform([], 0, width, tf.int32)
+    y0 = tf.clip_by_value(cy - cut_h // 2, 0, height)
+    y1 = tf.clip_by_value(cy + cut_h // 2, 0, height)
+    x0 = tf.clip_by_value(cx - cut_w // 2, 0, width)
+    x1 = tf.clip_by_value(cx + cut_w // 2, 0, width)
+    return y0, y1, x0, x1
+
+
+def cutmix(batch: dict, alpha: float = 1.0) -> dict:
+    """Paste a random box from the rolled partner; label ratio = kept area."""
+    images = tf.cast(batch["images"], tf.float32)
+    shape = tf.shape(images)
+    n, h, w = shape[0], shape[1], shape[2]
+    y0, y1, x0, x1 = _cutmix_box(h, w, alpha)
+    rows = tf.range(h)[None, :, None, None]
+    cols = tf.range(w)[None, None, :, None]
+    inside = (rows >= y0) & (rows < y1) & (cols >= x0) & (cols < x1)
+    mixed = tf.where(inside, tf.roll(images, 1, axis=0), images)
+    box_area = tf.cast((y1 - y0) * (x1 - x0), tf.float32)
+    ratio = 1.0 - box_area / tf.cast(h * w, tf.float32)
+    return dict(
+        batch,
+        images=mixed,
+        mix_labels=tf.roll(batch["labels"], 1, axis=0),
+        ratio=tf.fill([n], ratio),
+    )
+
+
+def mixup_or_cutmix(
+    batch: dict, *, mixup_alpha: float = 0.2, cutmix_alpha: float = 1.0
+) -> dict:
+    """Randomly apply MixUp or CutMix to the batch (reference
+    ``my_mixup_cutmix`` split the batch four ways; choosing per-batch keeps
+    whole-batch vectorization — input_pipeline.py:320-350)."""
+    return tf.cond(
+        tf.random.uniform([]) < 0.5,
+        lambda: mixup(batch, mixup_alpha),
+        lambda: cutmix(batch, cutmix_alpha),
+    )
+
+
+def apply_mixes(batch: dict, spec) -> dict:
+    """Apply the mix ops selected by an AugmentSpec."""
+    if spec.cutmix and spec.mixup:
+        return mixup_or_cutmix(
+            batch, mixup_alpha=spec.mixup_alpha, cutmix_alpha=spec.cutmix_alpha
+        )
+    if spec.mixup:
+        return mixup(batch, spec.mixup_alpha)
+    if spec.cutmix:
+        return cutmix(batch, spec.cutmix_alpha)
+    return batch

@@ -1,0 +1,122 @@
+#!/usr/bin/env python
+"""Training CLI.
+
+Reference-parity entry point (/root/reference/train.py:130-255: click CLI,
+steps math from ImageNet sizes, linear-scaled LR, eval every 5 epochs,
+checkpoint every 10) rebuilt on the pjit trainer: one typed TrainConfig, a
+single mesh, Orbax restore-on-start, and host-side logging outside the
+compiled step (the reference logged from inside pmap — SURVEY.md §2.9 #11).
+
+Examples:
+  python train.py --fake-data -m vit_ti_patch16 --image-size 32 --steps 20
+  python train.py --data-dir /data/imagenet -m deit_s_patch16 -c /ckpts/run1
+"""
+
+from __future__ import annotations
+
+import json
+
+import click
+
+
+@click.command(context_settings={"show_default": True})
+@click.option("--data-dir", type=str, default=None, help="TFDS/TFRecord root.")
+@click.option("--fake-data", is_flag=True, help="Zero batches, no real data.")
+@click.option("-m", "--model-name", default="deit_s_patch16")
+@click.option("--num-classes", type=int, default=1000)
+@click.option("--image-size", type=int, default=224)
+@click.option("--batch-size", type=int, default=1024, help="Global batch size.")
+@click.option("--num-epochs", type=int, default=300)
+@click.option("--learning-rate", type=float, default=5e-4, help="Base LR (×bs/512).")
+@click.option("--weight-decay", type=float, default=0.05)
+@click.option("--label-smoothing", type=float, default=0.1)
+@click.option("--clip-grad", type=float, default=1.0)
+@click.option(
+    "-a", "--augmentation", default="cutmix_mixup_randaugment_405",
+    help="Augment-string DSL (SURVEY.md §2.4).",
+)
+@click.option("--backend", type=click.Choice(["auto", "xla", "pallas"]), default="auto")
+@click.option("--dtype", type=click.Choice(["bfloat16", "float32"]), default="bfloat16")
+@click.option("--tp", type=int, default=1, help="Tensor-parallel mesh axis size.")
+@click.option("-c", "--checkpoint-dir", type=str, default=None)
+@click.option("--steps", type=int, default=None, help="Override total steps.")
+@click.option("--seed", type=int, default=42)
+def main(
+    data_dir, fake_data, model_name, num_classes, image_size, batch_size,
+    num_epochs, learning_rate, weight_decay, label_smoothing, clip_grad,
+    augmentation, backend, dtype, tp, checkpoint_dir, steps, seed,
+):
+    import jax
+
+    from sav_tpu.data.pipeline import Split, load
+    from sav_tpu.parallel import create_mesh, distributed_init
+    from sav_tpu.train import TrainConfig, Trainer
+
+    distributed_init()
+    n_devices = len(jax.devices())
+    mesh_axes = {"data": n_devices // tp, "model": tp} if tp > 1 else None
+
+    config = TrainConfig(
+        model_name=model_name,
+        num_classes=num_classes,
+        image_size=image_size,
+        compute_dtype=dtype,
+        attention_backend=None if backend == "auto" else backend,
+        global_batch_size=batch_size,
+        augment=augmentation,
+        num_epochs=num_epochs,
+        base_lr=learning_rate,
+        weight_decay=weight_decay,
+        label_smoothing=label_smoothing,
+        clip_grad_norm=clip_grad,
+        mesh_axes=mesh_axes,
+        checkpoint_dir=checkpoint_dir,
+        seed=seed,
+    )
+    if jax.process_index() == 0:
+        click.echo(config.to_json())
+
+    per_host_batch = batch_size // jax.process_count()
+    train_iter = load(
+        Split.TRAIN,
+        data_dir=data_dir,
+        is_training=True,
+        batch_dims=[per_host_batch],
+        image_size=image_size,
+        augment_name=augmentation,
+        transpose=config.transpose_images,
+        bfloat16=dtype == "bfloat16",
+        fake_data=fake_data,
+        seed=seed,
+    )
+
+    def eval_iter_fn():
+        return load(
+            Split.TEST,
+            data_dir=data_dir,
+            is_training=False,
+            batch_dims=[per_host_batch],
+            image_size=image_size,
+            transpose=config.transpose_images,
+            bfloat16=dtype == "bfloat16",
+            fake_data=fake_data,
+        )
+
+    trainer = Trainer(config, mesh=create_mesh(mesh_axes))
+
+    def log_fn(metrics):
+        if jax.process_index() == 0:
+            click.echo(json.dumps(metrics))
+
+    state, history = trainer.fit(
+        train_iter,
+        num_steps=steps,
+        eval_iter_fn=None if fake_data else eval_iter_fn,
+        log_fn=log_fn,
+    )
+    if jax.process_index() == 0:
+        click.echo(f"done at step {int(jax.device_get(state.step))}")
+
+
+if __name__ == "__main__":
+    main()

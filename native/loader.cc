@@ -1,0 +1,119 @@
+// sav_tpu native loader core.
+//
+// The reference's only native-code surface is TF's C++ tf.data runtime and
+// JPEG ops (SURVEY.md §2.8). This library is the TPU-framework equivalent
+// for the host-side hot loop the survey singles out (input_pipeline.py
+// :187-196, 226-243): batch normalization (uint8 → float, mean/std in
+// 0-255 scale), the NHWC→HWCN double-transpose, float32→bfloat16
+// conversion (the "late cast"), and batch gather/assembly — all threaded
+// and SIMD-friendly, exported with a C ABI for ctypes (no pybind11 in the
+// image).
+//
+// Build: `make -C native` → native/libsavtpu_loader.so
+
+#include <atomic>
+#include <cstdint>
+#include <cstring>
+#include <thread>
+#include <vector>
+
+namespace {
+
+// Run fn(i) for i in [0, n) over `threads` workers.
+template <typename F>
+void parallel_for(int64_t n, int threads, F fn) {
+  if (threads <= 1 || n < 2) {
+    for (int64_t i = 0; i < n; ++i) fn(i);
+    return;
+  }
+  std::atomic<int64_t> next(0);
+  std::vector<std::thread> pool;
+  pool.reserve(threads);
+  for (int t = 0; t < threads; ++t) {
+    pool.emplace_back([&] {
+      for (int64_t i = next.fetch_add(1); i < n; i = next.fetch_add(1)) fn(i);
+    });
+  }
+  for (auto& th : pool) th.join();
+}
+
+inline uint16_t f32_to_bf16_scalar(float x) {
+  uint32_t bits;
+  std::memcpy(&bits, &x, sizeof(bits));
+  // NaN must stay NaN: the rounding add below would carry into the exponent
+  // and produce Inf. Quiet the NaN like ml_dtypes does.
+  if ((bits & 0x7F800000u) == 0x7F800000u && (bits & 0x007FFFFFu)) {
+    return static_cast<uint16_t>((bits >> 16) | 0x0040u);
+  }
+  // Round-to-nearest-even on the truncated mantissa.
+  uint32_t rounding = 0x7FFF + ((bits >> 16) & 1);
+  return static_cast<uint16_t>((bits + rounding) >> 16);
+}
+
+}  // namespace
+
+extern "C" {
+
+// uint8 [N,H,W,C] → float32, normalized (x - mean[c]) / std[c].
+// transpose == 0: out is [N,H,W,C]; transpose == 1: out is [H,W,C,N]
+// (the reference's HWCN device-feed layout).
+void sav_normalize_batch(const uint8_t* in, float* out, int64_t n, int64_t h,
+                         int64_t w, int64_t c, const float* mean,
+                         const float* stddev, int transpose, int threads) {
+  const int64_t hwc = h * w * c;
+  std::vector<float> inv(c);
+  for (int64_t k = 0; k < c; ++k) inv[k] = 1.0f / stddev[k];
+  parallel_for(n, threads, [&](int64_t i) {
+    const uint8_t* src = in + i * hwc;
+    if (!transpose) {
+      float* dst = out + i * hwc;
+      for (int64_t j = 0; j < hwc; ++j) {
+        const int64_t ch = j % c;
+        dst[j] = (static_cast<float>(src[j]) - mean[ch]) * inv[ch];
+      }
+    } else {
+      // out[(j * n) + i] for flattened pixel index j: [H,W,C,N].
+      for (int64_t j = 0; j < hwc; ++j) {
+        const int64_t ch = j % c;
+        out[j * n + i] = (static_cast<float>(src[j]) - mean[ch]) * inv[ch];
+      }
+    }
+  });
+}
+
+// float32 → bfloat16 (round-to-nearest-even), elementwise.
+void sav_f32_to_bf16(const float* in, uint16_t* out, int64_t count,
+                     int threads) {
+  const int64_t chunk = 1 << 16;
+  const int64_t n_chunks = (count + chunk - 1) / chunk;
+  parallel_for(n_chunks, threads, [&](int64_t ci) {
+    const int64_t lo = ci * chunk;
+    const int64_t hi = lo + chunk < count ? lo + chunk : count;
+    for (int64_t i = lo; i < hi; ++i) out[i] = f32_to_bf16_scalar(in[i]);
+  });
+}
+
+// Gather items from a contiguous pool into a batch: out[i] = pool[indices[i]].
+void sav_gather_batch(const uint8_t* pool, const int32_t* indices,
+                      uint8_t* out, int64_t n, int64_t item_bytes,
+                      int threads) {
+  parallel_for(n, threads, [&](int64_t i) {
+    std::memcpy(out + i * item_bytes,
+                pool + static_cast<int64_t>(indices[i]) * item_bytes,
+                item_bytes);
+  });
+}
+
+// NHWC float32 → HWCN float32 (double-transpose device-feed layout).
+void sav_transpose_nhwc_to_hwcn(const float* in, float* out, int64_t n,
+                                int64_t h, int64_t w, int64_t c, int threads) {
+  const int64_t hwc = h * w * c;
+  parallel_for(n, threads, [&](int64_t i) {
+    const float* src = in + i * hwc;
+    for (int64_t j = 0; j < hwc; ++j) out[j * n + i] = src[j];
+  });
+}
+
+int sav_loader_abi_version() { return 1; }
+
+}  // extern "C"

@@ -1,9 +1,15 @@
 from sav_tpu.data.augment_spec import AugmentSpec, parse_augment_spec
+from sav_tpu.data.native_loader import (
+    PrefetchLoader,
+    native_available,
+)
 from sav_tpu.data.synthetic import fake_data_iterator, synthetic_data_iterator
 
 __all__ = [
     "AugmentSpec",
     "parse_augment_spec",
+    "PrefetchLoader",
+    "native_available",
     "fake_data_iterator",
     "synthetic_data_iterator",
     "load",

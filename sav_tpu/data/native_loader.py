@@ -1,0 +1,221 @@
+"""ctypes bindings for the native loader core + a threaded prefetcher.
+
+The C++ library (``native/loader.cc``) accelerates the host-side hot loop of
+the input pipeline — normalize, HWCN transpose, late bf16 cast, batch gather
+(/root/reference/input_pipeline.py:187-196, 226-243 equivalents). Every entry
+point has a numpy fallback so the framework works without the build step;
+``native_available()`` reports which path is active. ctypes calls release
+the GIL, so the ``PrefetchLoader`` worker threads overlap this byte work
+with device compute.
+
+Build once: ``make -C native`` (plain g++, no pybind11 dependency).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import queue
+import threading
+from typing import Iterator, Optional
+
+import numpy as np
+
+try:
+    import ml_dtypes
+
+    _BF16 = np.dtype(ml_dtypes.bfloat16)
+except ImportError:  # pragma: no cover
+    _BF16 = None
+
+_LIB_PATH = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__)))),
+    "native",
+    "libsavtpu_loader.so",
+)
+_lib = None
+
+
+def _load():
+    global _lib
+    if _lib is not None:
+        return _lib
+    if not os.path.exists(_LIB_PATH):
+        return None
+    lib = ctypes.CDLL(_LIB_PATH)
+    lib.sav_loader_abi_version.restype = ctypes.c_int
+    if lib.sav_loader_abi_version() != 1:  # pragma: no cover
+        return None
+    c_f32p = ctypes.POINTER(ctypes.c_float)
+    c_u8p = ctypes.POINTER(ctypes.c_uint8)
+    c_u16p = ctypes.POINTER(ctypes.c_uint16)
+    c_i32p = ctypes.POINTER(ctypes.c_int32)
+    lib.sav_normalize_batch.argtypes = [
+        c_u8p, c_f32p, ctypes.c_int64, ctypes.c_int64, ctypes.c_int64,
+        ctypes.c_int64, c_f32p, c_f32p, ctypes.c_int, ctypes.c_int,
+    ]
+    lib.sav_f32_to_bf16.argtypes = [c_f32p, c_u16p, ctypes.c_int64, ctypes.c_int]
+    lib.sav_gather_batch.argtypes = [
+        c_u8p, c_i32p, c_u8p, ctypes.c_int64, ctypes.c_int64, ctypes.c_int,
+    ]
+    lib.sav_transpose_nhwc_to_hwcn.argtypes = [
+        c_f32p, c_f32p, ctypes.c_int64, ctypes.c_int64, ctypes.c_int64,
+        ctypes.c_int64, ctypes.c_int,
+    ]
+    _lib = lib
+    return lib
+
+
+def native_available() -> bool:
+    return _load() is not None
+
+
+def _threads(n: Optional[int]) -> int:
+    return n if n is not None else min(8, os.cpu_count() or 1)
+
+
+def normalize_batch(
+    images: np.ndarray,
+    mean,
+    stddev,
+    *,
+    transpose: bool = False,
+    num_threads: Optional[int] = None,
+) -> np.ndarray:
+    """uint8 [N,H,W,C] → normalized float32 ([N,H,W,C] or HWCN)."""
+    assert images.dtype == np.uint8 and images.ndim == 4
+    n, h, w, c = images.shape
+    lib = _load()
+    # Broadcast scalars/short vectors up front so the C kernel always sees
+    # exactly C contiguous floats (the numpy fallback would broadcast anyway).
+    mean = np.ascontiguousarray(np.broadcast_to(np.asarray(mean, np.float32), (c,)))
+    stddev = np.ascontiguousarray(
+        np.broadcast_to(np.asarray(stddev, np.float32), (c,))
+    )
+    if lib is None:
+        out = (images.astype(np.float32) - mean) / stddev
+        return np.transpose(out, (1, 2, 3, 0)) if transpose else out
+    images = np.ascontiguousarray(images)
+    out_shape = (h, w, c, n) if transpose else (n, h, w, c)
+    out = np.empty(out_shape, np.float32)
+    lib.sav_normalize_batch(
+        images.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+        out.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+        n, h, w, c,
+        mean.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+        stddev.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+        int(transpose), _threads(num_threads),
+    )
+    return out
+
+
+def f32_to_bf16(x: np.ndarray, *, num_threads: Optional[int] = None) -> np.ndarray:
+    """float32 → bfloat16 (round-to-nearest-even), threaded."""
+    if _BF16 is None:  # pragma: no cover
+        raise RuntimeError("ml_dtypes unavailable")
+    lib = _load()
+    x = np.ascontiguousarray(x, np.float32)
+    if lib is None:
+        return x.astype(_BF16)
+    out = np.empty(x.shape, np.uint16)
+    lib.sav_f32_to_bf16(
+        x.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+        out.ctypes.data_as(ctypes.POINTER(ctypes.c_uint16)),
+        x.size, _threads(num_threads),
+    )
+    return out.view(_BF16)
+
+
+def gather_batch(
+    pool: np.ndarray, indices: np.ndarray, *, num_threads: Optional[int] = None
+) -> np.ndarray:
+    """out[i] = pool[indices[i]] for contiguous fixed-size items.
+
+    Indices must be in ``[0, len(pool))`` — negative (numpy-wrap) indices are
+    rejected so the native memcpy path and the numpy fallback agree.
+    """
+    lib = _load()
+    indices = np.ascontiguousarray(indices, np.int32)
+    if indices.size and (indices.min() < 0 or indices.max() >= len(pool)):
+        raise IndexError(
+            f"indices out of range [0, {len(pool)}): "
+            f"[{indices.min()}, {indices.max()}]"
+        )
+    if lib is None:
+        return pool[indices].copy()
+    pool = np.ascontiguousarray(pool)
+    item_bytes = pool[0].nbytes
+    out = np.empty((len(indices),) + pool.shape[1:], pool.dtype)
+    lib.sav_gather_batch(
+        pool.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+        indices.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+        out.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+        len(indices), item_bytes, _threads(num_threads),
+    )
+    return out
+
+
+def transpose_nhwc_to_hwcn(
+    x: np.ndarray, *, num_threads: Optional[int] = None
+) -> np.ndarray:
+    lib = _load()
+    x = np.ascontiguousarray(x, np.float32)
+    if lib is None:
+        return np.transpose(x, (1, 2, 3, 0)).copy()
+    n, h, w, c = x.shape
+    out = np.empty((h, w, c, n), np.float32)
+    lib.sav_transpose_nhwc_to_hwcn(
+        x.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+        out.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+        n, h, w, c, _threads(num_threads),
+    )
+    return out
+
+
+class PrefetchLoader:
+    """Bounded background prefetch over any batch iterator.
+
+    The tf.data path has its own C++ prefetch; this covers every other
+    source (synthetic, native-assembled, custom) so host work overlaps
+    device steps. Iteration order is preserved (single worker per iterator
+    semantics; the byte-heavy transforms above run with the GIL released).
+    """
+
+    def __init__(self, iterator: Iterator[dict], *, depth: int = 2, transform=None):
+        self._iterator = iterator
+        self._transform = transform
+        self._queue: queue.Queue = queue.Queue(maxsize=depth)
+        self._done = object()
+        self._err: Optional[BaseException] = None
+        self._finished = False
+        self._thread = threading.Thread(target=self._worker, daemon=True)
+        self._thread.start()
+
+    def _worker(self):
+        try:
+            for item in self._iterator:
+                if self._transform is not None:
+                    item = self._transform(item)
+                self._queue.put(item)
+        except BaseException as e:  # propagate to the consumer
+            self._err = e
+        finally:
+            self._queue.put(self._done)
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        # Terminal states persist: the sentinel is consumed exactly once, so
+        # later next() calls must not block on an empty queue.
+        if self._finished:
+            if self._err is not None:
+                raise self._err
+            raise StopIteration
+        item = self._queue.get()
+        if item is self._done:
+            self._finished = True
+            if self._err is not None:
+                raise self._err
+            raise StopIteration
+        return item

@@ -358,10 +358,20 @@ def load(
     ds = ds.map(finalize, num_parallel_calls=tf.data.AUTOTUNE)
     ds = ds.prefetch(tf.data.AUTOTUNE)
 
+    if bfloat16 and _BF16 is not None:
+        # Late cast on the host halves host→device bytes (the reference's
+        # bf16 view fix-up, input_pipeline.py:238-243); the native loader
+        # core does it threaded with the GIL released when built.
+        from sav_tpu.data.native_loader import f32_to_bf16
+
+        def _cast(b):
+            b["images"] = f32_to_bf16(b["images"])
+            return b
+    else:
+        _cast = lambda b: b
+
     for batch in ds.as_numpy_iterator():
-        if bfloat16 and _BF16 is not None:
-            batch["images"] = batch["images"].astype(_BF16)
-        yield batch
+        yield _cast(dict(batch))
 
 
 def _fake_batches(batch_dims, image_size, transpose, bfloat16):

@@ -7,6 +7,7 @@ from sav_tpu.parallel.mesh import (
     distributed_init,
     replicated,
 )
+from sav_tpu.parallel.ring_attention import ring_attention
 from sav_tpu.parallel.sharding import (
     DEFAULT_TP_RULES,
     param_path_specs,
@@ -26,4 +27,5 @@ __all__ = [
     "param_path_specs",
     "param_shardings",
     "shard_params",
+    "ring_attention",
 ]

@@ -1,0 +1,155 @@
+"""Native C++ loader core vs numpy reference."""
+
+import subprocess
+import sys
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+REPO = Path(__file__).resolve().parent.parent
+
+
+@pytest.fixture(scope="module", autouse=True)
+def built_lib():
+    lib = REPO / "native" / "libsavtpu_loader.so"
+    if not lib.exists():
+        try:
+            subprocess.run(
+                ["make", "-C", str(REPO / "native")], check=True, capture_output=True
+            )
+        except (subprocess.CalledProcessError, FileNotFoundError) as e:
+            pytest.skip(f"native build unavailable: {e}")
+    return lib
+
+
+def test_native_is_loaded(built_lib):
+    from sav_tpu.data import native_loader as nl
+
+    assert nl.native_available()
+
+
+def test_normalize_matches_numpy():
+    from sav_tpu.data import native_loader as nl
+    from sav_tpu.data.pipeline import MEAN_RGB, STDDEV_RGB
+
+    rng = np.random.default_rng(0)
+    images = rng.integers(0, 256, (8, 16, 16, 3), dtype=np.uint8)
+    ref = (images.astype(np.float32) - np.asarray(MEAN_RGB, np.float32)) / np.asarray(
+        STDDEV_RGB, np.float32
+    )
+    out = nl.normalize_batch(images, MEAN_RGB, STDDEV_RGB)
+    np.testing.assert_allclose(out, ref, rtol=1e-6)
+
+    out_t = nl.normalize_batch(images, MEAN_RGB, STDDEV_RGB, transpose=True)
+    np.testing.assert_allclose(out_t, np.transpose(ref, (1, 2, 3, 0)), rtol=1e-6)
+
+
+def test_bf16_cast_matches_ml_dtypes():
+    import ml_dtypes
+
+    from sav_tpu.data import native_loader as nl
+
+    rng = np.random.default_rng(1)
+    x = rng.standard_normal((1000,)).astype(np.float32) * 100
+    x = np.concatenate([x, [0.0, -0.0, 1e-38, 3.4e38, -3.4e38]]).astype(np.float32)
+    out = nl.f32_to_bf16(x)
+    ref = x.astype(ml_dtypes.bfloat16)
+    np.testing.assert_array_equal(
+        out.view(np.uint16), ref.view(np.uint16)
+    )
+
+
+def test_bf16_cast_preserves_nan():
+    from sav_tpu.data import native_loader as nl
+
+    x = np.array([np.nan, np.inf, -np.inf, 1.5], np.float32)
+    out = nl.f32_to_bf16(x).astype(np.float32)
+    assert np.isnan(out[0]) and np.isinf(out[1]) and np.isinf(out[2])
+
+
+def test_gather_batch_rejects_out_of_range():
+    from sav_tpu.data import native_loader as nl
+
+    pool = np.zeros((4, 2, 2, 3), np.uint8)
+    with pytest.raises(IndexError):
+        nl.gather_batch(pool, np.array([0, 4], np.int32))
+    with pytest.raises(IndexError):
+        nl.gather_batch(pool, np.array([-1], np.int32))
+
+
+def test_normalize_scalar_mean_broadcast():
+    from sav_tpu.data import native_loader as nl
+
+    images = np.full((2, 4, 4, 3), 100, np.uint8)
+    out = nl.normalize_batch(images, 50.0, 2.0)
+    np.testing.assert_allclose(out, 25.0)
+
+
+def test_prefetch_exhausted_keeps_raising():
+    from sav_tpu.data.native_loader import PrefetchLoader
+
+    it = PrefetchLoader(iter([{"a": 1}]), depth=1)
+    assert next(it) == {"a": 1}
+    for _ in range(3):  # must raise StopIteration every time, never block
+        with pytest.raises(StopIteration):
+            next(it)
+
+
+def test_gather_batch():
+    from sav_tpu.data import native_loader as nl
+
+    rng = np.random.default_rng(2)
+    pool = rng.integers(0, 256, (32, 8, 8, 3), dtype=np.uint8)
+    idx = rng.integers(0, 32, (16,), dtype=np.int32)
+    np.testing.assert_array_equal(nl.gather_batch(pool, idx), pool[idx])
+
+
+def test_transpose_hwcn():
+    from sav_tpu.data import native_loader as nl
+
+    rng = np.random.default_rng(3)
+    x = rng.standard_normal((4, 6, 5, 3)).astype(np.float32)
+    np.testing.assert_array_equal(
+        nl.transpose_nhwc_to_hwcn(x), np.transpose(x, (1, 2, 3, 0))
+    )
+
+
+def test_prefetch_loader_order_and_exhaustion():
+    from sav_tpu.data.native_loader import PrefetchLoader
+
+    items = [{"i": np.array([k])} for k in range(20)]
+    out = list(PrefetchLoader(iter(items), depth=3))
+    assert [int(b["i"][0]) for b in out] == list(range(20))
+
+
+def test_prefetch_loader_propagates_errors():
+    from sav_tpu.data.native_loader import PrefetchLoader
+
+    def gen():
+        yield {"a": 1}
+        raise RuntimeError("boom")
+
+    it = PrefetchLoader(gen(), depth=2)
+    assert next(it) == {"a": 1}
+    with pytest.raises(RuntimeError, match="boom"):
+        next(it)
+
+
+def test_prefetch_with_native_transform():
+    from sav_tpu.data import native_loader as nl
+
+    rng = np.random.default_rng(4)
+    batches = [
+        {"images": rng.integers(0, 256, (4, 8, 8, 3), dtype=np.uint8)}
+        for _ in range(5)
+    ]
+
+    def transform(b):
+        return {"images": nl.normalize_batch(b["images"], (0, 0, 0), (1, 1, 1))}
+
+    out = list(nl.PrefetchLoader(iter(batches), transform=transform))
+    assert len(out) == 5
+    np.testing.assert_allclose(
+        out[0]["images"], batches[0]["images"].astype(np.float32), rtol=1e-6
+    )

@@ -1,11 +1,18 @@
 from sav_tpu.parallel.mesh import (
     DATA_AXIS,
+    EXPERT_AXIS,
     MODEL_AXIS,
+    PIPE_AXIS,
     SEQ_AXIS,
     batch_sharding,
     create_mesh,
     distributed_init,
     replicated,
+)
+from sav_tpu.parallel.pipelining import (
+    pipeline,
+    stack_stage_params,
+    stage_param_shardings,
 )
 from sav_tpu.parallel.ring_attention import ring_attention
 from sav_tpu.parallel.sharding import (
@@ -17,8 +24,13 @@ from sav_tpu.parallel.sharding import (
 
 __all__ = [
     "DATA_AXIS",
+    "EXPERT_AXIS",
     "MODEL_AXIS",
+    "PIPE_AXIS",
     "SEQ_AXIS",
+    "pipeline",
+    "stack_stage_params",
+    "stage_param_shardings",
     "batch_sharding",
     "create_mesh",
     "distributed_init",

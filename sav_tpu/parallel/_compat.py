@@ -1,0 +1,29 @@
+"""shard_map compatibility across jax versions.
+
+jax >= 0.8 promotes ``shard_map`` to ``jax.shard_map`` and renames
+``check_rep`` → ``check_vma``; the experimental import still works but warns.
+All framework call sites import :func:`shard_map` from here.
+"""
+
+from __future__ import annotations
+
+import functools
+import inspect
+
+import jax
+
+if hasattr(jax, "shard_map"):
+    _impl = jax.shard_map
+    _params = inspect.signature(_impl).parameters
+    if "check_rep" in _params:
+        shard_map = _impl
+    else:
+
+        @functools.wraps(_impl)
+        def shard_map(f=None, /, *, check_rep=None, **kwargs):
+            if check_rep is not None:
+                kwargs.setdefault("check_vma", check_rep)
+            return _impl(f, **kwargs)
+
+else:  # pragma: no cover - older jax
+    from jax.experimental.shard_map import shard_map  # noqa: F401

@@ -17,10 +17,13 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 # Canonical axis names. data = batch (DP), model = tensor parallel (TP),
-# seq = sequence/context parallel (ring attention).
+# seq = sequence/context parallel (ring attention), pipe = pipeline stages,
+# expert = MoE expert parallelism.
 DATA_AXIS = "data"
 MODEL_AXIS = "model"
 SEQ_AXIS = "seq"
+PIPE_AXIS = "pipe"
+EXPERT_AXIS = "expert"
 
 _distributed_initialized = False
 

@@ -24,7 +24,8 @@ from typing import Optional
 import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
-from jax.experimental.shard_map import shard_map
+
+from sav_tpu.parallel._compat import shard_map
 
 from sav_tpu.parallel.mesh import SEQ_AXIS
 

@@ -51,6 +51,13 @@ class TrainConfig:
     checkpoint_keep: int = 3
     log_every_steps: int = 100
 
+    # Observability / debugging (SURVEY.md §5 — none of this existed in the
+    # reference): optional jax.profiler trace window and NaN guards.
+    profile_dir: Optional[str] = None
+    profile_start_step: int = 10
+    profile_num_steps: int = 5
+    debug_nans: bool = False
+
     @property
     def steps_per_epoch(self) -> int:
         return self.num_train_images // self.global_batch_size

@@ -32,6 +32,7 @@ from sav_tpu.train.checkpoint import Checkpointer
 from sav_tpu.train.config import TrainConfig
 from sav_tpu.train.optimizer import make_optimizer, warmup_cosine_schedule
 from sav_tpu.train.state import TrainState
+from sav_tpu.utils.debug import assert_all_finite
 from sav_tpu.utils.metrics import cross_entropy, topk_correct
 
 
@@ -297,40 +298,59 @@ class Trainer:
         t_last = time.time()
         last_logged_step = start_step
         last_saved_step = None
-        for step, batch in zip(range(start_step, num_steps), train_iter):
-            state, metrics = self.train_step(state, batch, rng)
-            if (step + 1) % cfg.log_every_steps == 0 or step + 1 == num_steps:
-                m = {k: float(v) for k, v in jax.device_get(metrics).items()}
-                now = time.time()
-                m["step"] = step + 1
-                steps_since = step + 1 - last_logged_step
-                m["images_per_sec"] = (
-                    cfg.global_batch_size * steps_since / max(now - t_last, 1e-9)
-                )
-                t_last = now
-                last_logged_step = step + 1
-                history.append(m)
-                if log_fn is not None:
-                    log_fn(m)
-            epoch_done = (step + 1) % cfg.steps_per_epoch == 0
-            if epoch_done:
-                epoch = (step + 1) // cfg.steps_per_epoch
-                if eval_iter_fn is not None and epoch % cfg.eval_every_epochs == 0:
-                    em = self.evaluate(state, eval_iter_fn())
-                    em["step"] = step + 1
-                    history.append(em)
+        # jax.profiler trace window (SURVEY.md §5): capture a few steady-state
+        # steps, skipping compile/warmup. Relative to start_step so resumed
+        # runs still profile.
+        prof_start = start_step + cfg.profile_start_step
+        prof_stop = prof_start + max(cfg.profile_num_steps, 1)
+        profiling = False
+        try:
+            for step, batch in zip(range(start_step, num_steps), train_iter):
+                if cfg.profile_dir is not None:
+                    if not profiling and prof_start <= step < prof_stop:
+                        jax.profiler.start_trace(cfg.profile_dir)
+                        profiling = True
+                    elif profiling and step >= prof_stop:
+                        jax.profiler.stop_trace()
+                        profiling = False
+                state, metrics = self.train_step(state, batch, rng)
+                if cfg.debug_nans:
+                    assert_all_finite(metrics, f"metrics at step {step + 1}")
+                if (step + 1) % cfg.log_every_steps == 0 or step + 1 == num_steps:
+                    m = {k: float(v) for k, v in jax.device_get(metrics).items()}
+                    now = time.time()
+                    m["step"] = step + 1
+                    steps_since = step + 1 - last_logged_step
+                    m["images_per_sec"] = (
+                        cfg.global_batch_size * steps_since / max(now - t_last, 1e-9)
+                    )
+                    t_last = now
+                    last_logged_step = step + 1
+                    history.append(m)
                     if log_fn is not None:
-                        log_fn(em)
-                if (
-                    self.checkpointer is not None
-                    and epoch % cfg.checkpoint_every_epochs == 0
-                ):
-                    self.checkpointer.save(step + 1, state)
-                    last_saved_step = step + 1
-                # Reset the throughput window so eval/checkpoint wall time
-                # doesn't deflate the next logged images_per_sec.
-                t_last = time.time()
-                last_logged_step = step + 1
+                        log_fn(m)
+                epoch_done = (step + 1) % cfg.steps_per_epoch == 0
+                if epoch_done:
+                    epoch = (step + 1) // cfg.steps_per_epoch
+                    if eval_iter_fn is not None and epoch % cfg.eval_every_epochs == 0:
+                        em = self.evaluate(state, eval_iter_fn())
+                        em["step"] = step + 1
+                        history.append(em)
+                        if log_fn is not None:
+                            log_fn(em)
+                    if (
+                        self.checkpointer is not None
+                        and epoch % cfg.checkpoint_every_epochs == 0
+                    ):
+                        self.checkpointer.save(step + 1, state)
+                        last_saved_step = step + 1
+                    # Reset the throughput window so eval/checkpoint wall time
+                    # doesn't deflate the next logged images_per_sec.
+                    t_last = time.time()
+                    last_logged_step = step + 1
+        finally:
+            if profiling:
+                jax.profiler.stop_trace()
         if self.checkpointer is not None:
             if last_saved_step != num_steps:
                 self.checkpointer.save(num_steps, state)

@@ -1,3 +1,44 @@
 from sav_tpu.utils.metrics import topk_correct, accuracy_topk, cross_entropy
+from sav_tpu.utils.param_overview import (
+    count_parameters,
+    log_parameter_overview,
+    parameter_overview,
+)
+from sav_tpu.utils.profiler import StepTimer, annotate, benchmark_fn, trace
+from sav_tpu.utils.debug import (
+    assert_all_finite,
+    checkify_step,
+    find_nonfinite,
+    global_norm_nonfinite,
+)
+from sav_tpu.utils.writers import (
+    JsonlWriter,
+    LoggingWriter,
+    MetricWriter,
+    MultiWriter,
+    TensorBoardWriter,
+    WandbWriter,
+)
 
-__all__ = ["topk_correct", "accuracy_topk", "cross_entropy"]
+__all__ = [
+    "topk_correct",
+    "accuracy_topk",
+    "cross_entropy",
+    "count_parameters",
+    "parameter_overview",
+    "log_parameter_overview",
+    "StepTimer",
+    "annotate",
+    "benchmark_fn",
+    "trace",
+    "assert_all_finite",
+    "checkify_step",
+    "find_nonfinite",
+    "global_norm_nonfinite",
+    "JsonlWriter",
+    "LoggingWriter",
+    "MetricWriter",
+    "MultiWriter",
+    "TensorBoardWriter",
+    "WandbWriter",
+]

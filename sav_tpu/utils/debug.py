@@ -1,0 +1,80 @@
+"""Numerics debugging — the framework's 'sanitizer' layer.
+
+Functional JAX makes in-model data races a non-issue (SURVEY.md §5 'Race
+detection'), so the debugging surface that matters on TPU is *numerics*:
+NaN/Inf escapes in bf16 training. Two mechanisms:
+
+- :func:`find_nonfinite` / :func:`assert_all_finite` — host-side tree
+  checks that name the offending leaves, used by the trainer's
+  ``debug_nans`` mode on logged metrics/gradients (zero cost when off).
+- :func:`checkify_step` — wraps a jitted step with ``jax.experimental
+  .checkify`` NaN checks for in-graph detection when hunting an
+  intermittent blow-up.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+from sav_tpu.utils.param_overview import _path_str
+
+
+def find_nonfinite(tree: Any) -> list[str]:
+    """Paths of leaves containing NaN/Inf (host-side; device_gets the tree)."""
+    host = jax.device_get(tree)
+    bad = []
+    for path, leaf in jax.tree_util.tree_flatten_with_path(host)[0]:
+        # jnp.issubdtype, not numpy dtype.kind: bfloat16 (ml_dtypes) has
+        # kind 'V' and would silently pass a kind=='f' check.
+        if not jnp.issubdtype(np.asarray(leaf).dtype, jnp.floating):
+            continue
+        arr = np.asarray(leaf, dtype=np.float32)
+        if not np.isfinite(arr).all():
+            bad.append(_path_str(path))
+    return bad
+
+
+def assert_all_finite(tree: Any, name: str = "tree") -> None:
+    """Raise ``FloatingPointError`` naming non-finite leaves."""
+    bad = find_nonfinite(tree)
+    if bad:
+        raise FloatingPointError(f"non-finite values in {name}: {bad}")
+
+
+def global_norm_nonfinite(tree: Any) -> jax.Array:
+    """In-graph scalar: 1.0 if any float leaf contains NaN/Inf, else 0.0.
+
+    Cheap enough to compute every step (one reduction per leaf, fused by
+    XLA); log it and alert host-side instead of device_getting full trees.
+    """
+    flags = [
+        jnp.any(~jnp.isfinite(x))
+        for x in jax.tree.leaves(tree)
+        if jnp.issubdtype(x.dtype, jnp.floating)
+    ]
+    if not flags:
+        return jnp.zeros((), jnp.float32)
+    return jnp.max(jnp.stack([f.astype(jnp.float32) for f in flags]))
+
+
+def checkify_step(step_fn: Callable) -> Callable:
+    """Wrap a step function with in-graph NaN/div checks.
+
+    Returns a function with the same signature whose errors are raised
+    host-side after the step (``err.throw()``).
+    """
+    from jax.experimental import checkify
+
+    checked = checkify.checkify(step_fn, errors=checkify.nan_checks)
+
+    def wrapper(*args, **kwargs):
+        err, out = checked(*args, **kwargs)
+        err.throw()
+        return out
+
+    return wrapper

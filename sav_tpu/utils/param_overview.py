@@ -1,0 +1,53 @@
+"""Parameter overview — counts and a human-readable table.
+
+TPU-native stand-in for the reference's use of
+``clu.parameter_overview.count_parameters`` (the only observability it had,
+/root/reference/experiments/base.py:79-80): module-path param counts,
+shapes, dtypes, and sharding info for mesh-sharded trees.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import numpy as np
+
+
+def _path_str(path) -> str:
+    return "/".join(
+        k.key if hasattr(k, "key") else str(getattr(k, "idx", k)) for k in path
+    )
+
+
+def count_parameters(params: Any) -> int:
+    """Total number of scalar parameters in the tree."""
+    return sum(int(np.prod(x.shape)) for x in jax.tree.leaves(params))
+
+
+def parameter_overview(params: Any, *, include_stats: bool = False) -> str:
+    """Formatted table: path, shape, dtype, #params (and sharding if any)."""
+    rows = []
+    flat = jax.tree_util.tree_flatten_with_path(params)[0]
+    for path, leaf in flat:
+        n = int(np.prod(leaf.shape))
+        sharding = ""
+        spec = getattr(getattr(leaf, "sharding", None), "spec", None)
+        if spec is not None and any(s is not None for s in spec):
+            sharding = str(spec)
+        rows.append((_path_str(path), str(leaf.shape), str(leaf.dtype), n, sharding))
+    total = sum(r[3] for r in rows)
+    width = max([len(r[0]) for r in rows] + [10])
+    lines = [f"{'Name':<{width}}  {'Shape':<18} {'Dtype':<9} {'Count':>12}  Sharding"]
+    lines += [
+        f"{name:<{width}}  {shape:<18} {dtype:<9} {n:>12,}  {sh}"
+        for name, shape, dtype, n, sh in rows
+    ]
+    lines.append(f"{'Total':<{width}}  {'':<18} {'':<9} {total:>12,}")
+    return "\n".join(lines)
+
+
+def log_parameter_overview(params: Any, *, log_fn=print) -> int:
+    """Print/log the overview; returns the total count."""
+    log_fn(parameter_overview(params))
+    return count_parameters(params)

@@ -1,0 +1,144 @@
+"""Metric writers — host-side observability sinks.
+
+The reference logged wandb scalars from *inside* the pmapped train step (a
+tracer leak, /root/reference/train.py:102-107; SURVEY.md §2.9 #11). Here
+metric emission is strictly host-side: the trainer hands a plain
+``dict[str, float]`` to a writer after ``device_get``. Writers compose via
+:class:`MultiWriter`; wandb and TensorBoard sinks import lazily and degrade
+to no-ops when the library isn't installed (neither is a framework
+dependency).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Mapping, Optional, Protocol, Sequence
+
+
+class MetricWriter(Protocol):
+    def write(self, step: int, metrics: Mapping[str, float]) -> None: ...
+    def close(self) -> None: ...
+
+
+class JsonlWriter:
+    """One JSON object per write, appended to ``<dir>/metrics.jsonl``."""
+
+    def __init__(self, log_dir: str, filename: str = "metrics.jsonl"):
+        os.makedirs(log_dir, exist_ok=True)
+        self._path = os.path.join(log_dir, filename)
+        self._f = open(self._path, "a", buffering=1)
+
+    @property
+    def path(self) -> str:
+        return self._path
+
+    def write(self, step: int, metrics: Mapping[str, float]) -> None:
+        rec = {"step": int(step)}
+        rec.update({k: float(v) for k, v in metrics.items()})
+        self._f.write(json.dumps(rec) + "\n")
+
+    def close(self) -> None:
+        self._f.close()
+
+
+class LoggingWriter:
+    """Writes through a callable (default ``print``) — the CLI sink."""
+
+    def __init__(self, log_fn=print):
+        self._log_fn = log_fn
+
+    def write(self, step: int, metrics: Mapping[str, float]) -> None:
+        parts = ", ".join(
+            f"{k}={v:.6g}" if isinstance(v, float) else f"{k}={v}"
+            for k, v in metrics.items()
+        )
+        self._log_fn(f"step {step}: {parts}")
+
+    def close(self) -> None:
+        pass
+
+
+class WandbWriter:
+    """Weights & Biases sink (the reference's logger, train.py:195-201).
+
+    Lazily imports ``wandb``; becomes a no-op if unavailable.
+    """
+
+    def __init__(self, project: str, *, config: Optional[dict] = None, **kwargs):
+        self._run = None
+        self._wandb = None
+        try:
+            import wandb  # type: ignore
+        except ImportError:
+            return  # library absent → silent no-op (documented behavior)
+        try:
+            self._run = wandb.init(project=project, config=config, **kwargs)
+            self._wandb = wandb
+        except Exception as e:  # installed but init failed (auth, network…)
+            import warnings
+
+            warnings.warn(f"wandb.init failed, metrics will not be logged: {e}")
+
+    @property
+    def active(self) -> bool:
+        return self._run is not None
+
+    def write(self, step: int, metrics: Mapping[str, float]) -> None:
+        if self._run is not None:
+            self._wandb.log(dict(metrics), step=int(step))
+
+    def close(self) -> None:
+        if self._run is not None:
+            self._run.finish()
+
+
+class TensorBoardWriter:
+    """TensorBoard scalar sink via ``tf.summary`` (TF ships with the data
+    pipeline); no-op when TF is unavailable."""
+
+    def __init__(self, log_dir: str):
+        self._tf = None
+        self._writer = None
+        try:
+            import tensorflow as tf  # type: ignore
+        except ImportError:
+            return  # library absent → silent no-op (documented behavior)
+        try:
+            self._writer = tf.summary.create_file_writer(log_dir)
+            self._tf = tf
+        except Exception as e:
+            import warnings
+
+            warnings.warn(f"TensorBoard writer init failed: {e}")
+
+    @property
+    def active(self) -> bool:
+        return self._writer is not None
+
+    def write(self, step: int, metrics: Mapping[str, float]) -> None:
+        if self._writer is None:
+            return
+        with self._writer.as_default():
+            for k, v in metrics.items():
+                self._tf.summary.scalar(k, float(v), step=int(step))
+            self._writer.flush()
+
+    def close(self) -> None:
+        if self._writer is not None:
+            self._writer.close()
+
+
+class MultiWriter:
+    """Fan-out to several writers."""
+
+    def __init__(self, writers: Sequence[MetricWriter]):
+        self._writers = list(writers)
+
+    def write(self, step: int, metrics: Mapping[str, float]) -> None:
+        for w in self._writers:
+            w.write(step, metrics)
+
+    def close(self) -> None:
+        for w in self._writers:
+            w.close()

@@ -1,0 +1,164 @@
+"""Observability & debugging utilities (SURVEY.md §5 — all new capability;
+the reference had only ``topk_correct`` and a clu param count)."""
+
+import json
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from sav_tpu.utils import (
+    JsonlWriter,
+    LoggingWriter,
+    MultiWriter,
+    StepTimer,
+    assert_all_finite,
+    benchmark_fn,
+    count_parameters,
+    find_nonfinite,
+    global_norm_nonfinite,
+    parameter_overview,
+    trace,
+)
+
+
+class TestParamOverview:
+    def test_count(self):
+        params = {"a": jnp.zeros((3, 4)), "b": {"c": jnp.zeros((5,))}}
+        assert count_parameters(params) == 17
+
+    def test_table_lists_paths_and_total(self):
+        params = {"layer": {"kernel": jnp.zeros((2, 2)), "bias": jnp.zeros((2,))}}
+        table = parameter_overview(params)
+        assert "layer/kernel" in table
+        assert "layer/bias" in table
+        assert "6" in table  # total
+
+    def test_sharding_column(self, devices):
+        from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+        mesh = Mesh(np.array(devices).reshape(8), ("data",))
+        x = jax.device_put(
+            jnp.zeros((8, 4)), NamedSharding(mesh, P("data", None))
+        )
+        table = parameter_overview({"w": x})
+        assert "data" in table
+
+
+class TestDebug:
+    def test_find_nonfinite_names_leaf(self):
+        tree = {"good": jnp.ones((3,)), "bad": jnp.array([1.0, jnp.nan])}
+        assert find_nonfinite(tree) == ["bad"]
+
+    def test_assert_all_finite_raises(self):
+        with pytest.raises(FloatingPointError, match="bad"):
+            assert_all_finite({"bad": jnp.array([jnp.inf])}, "grads")
+
+    def test_assert_all_finite_passes(self):
+        assert_all_finite({"x": jnp.ones((2, 2)), "i": jnp.arange(3)})
+
+    def test_find_nonfinite_bfloat16(self):
+        # ml_dtypes bfloat16 has numpy dtype.kind 'V'; the check must still
+        # see through it — bf16 is the dtype this debug layer exists for.
+        tree = {"bad": jnp.array([1.0, jnp.nan], dtype=jnp.bfloat16)}
+        assert find_nonfinite(tree) == ["bad"]
+
+    def test_global_norm_nonfinite_in_graph(self):
+        flag = jax.jit(global_norm_nonfinite)({"x": jnp.array([1.0, jnp.nan])})
+        assert float(flag) == 1.0
+        flag = jax.jit(global_norm_nonfinite)({"x": jnp.array([1.0, 2.0])})
+        assert float(flag) == 0.0
+
+
+class TestWriters:
+    def test_jsonl_roundtrip(self, tmp_path):
+        w = JsonlWriter(str(tmp_path))
+        w.write(1, {"loss": 2.5})
+        w.write(2, {"loss": 1.25, "acc": 0.5})
+        w.close()
+        lines = [json.loads(l) for l in open(w.path)]
+        assert lines == [
+            {"step": 1, "loss": 2.5},
+            {"step": 2, "loss": 1.25, "acc": 0.5},
+        ]
+
+    def test_logging_and_multi(self, tmp_path):
+        seen = []
+        multi = MultiWriter(
+            [LoggingWriter(log_fn=seen.append), JsonlWriter(str(tmp_path))]
+        )
+        multi.write(7, {"loss": 0.5})
+        multi.close()
+        assert len(seen) == 1 and "step 7" in seen[0] and "loss=0.5" in seen[0]
+
+
+class TestProfiler:
+    def test_step_timer_summary(self):
+        timer = StepTimer(items_per_step=32)
+        for _ in range(5):
+            timer.tick()
+        s = timer.summary()
+        assert s["step_time_mean_s"] >= 0.0
+        assert s["items_per_sec"] > 0
+        timer.reset()
+        timer.tick()  # no duration recorded across the reset
+        assert timer.num_ticks == 4
+
+    def test_benchmark_fn(self):
+        f = jax.jit(lambda x: x * 2.0)
+        stats = benchmark_fn(f, jnp.ones((8, 8)), iters=3, warmup=1)
+        assert stats["min_s"] > 0 and stats["iters"] == 3
+
+    def test_trace_noop_without_dir(self):
+        with trace(None):
+            pass
+
+    def test_trace_writes_files(self, tmp_path):
+        d = str(tmp_path / "prof")
+        with trace(d):
+            jax.jit(lambda x: x + 1)(jnp.ones((4,))).block_until_ready()
+        found = [
+            os.path.join(root, f)
+            for root, _, files in os.walk(d)
+            for f in files
+        ]
+        assert found, "profiler trace produced no files"
+
+
+class TestTrainerDebugNans:
+    def test_fit_raises_on_nan_loss(self, devices):
+        from sav_tpu.data import synthetic_data_iterator
+        from sav_tpu.models import create_model
+        from sav_tpu.train import TrainConfig, Trainer
+
+        config = TrainConfig(
+            model_name="vit_ti_patch16",
+            num_classes=10,
+            image_size=32,
+            compute_dtype="float32",
+            global_batch_size=8,
+            num_train_images=32,
+            num_epochs=2,
+            warmup_epochs=1,
+            transpose_images=False,
+            debug_nans=True,
+            log_every_steps=1,
+            seed=0,
+        )
+        model = create_model(
+            "vit_ti_patch16", num_classes=10, num_layers=1, embed_dim=32, num_heads=2
+        )
+        trainer = Trainer(config, model=model)
+
+        def nan_batches():
+            it = synthetic_data_iterator(batch_size=8, image_size=32, num_classes=10)
+            while True:
+                batch = dict(next(it))
+                batch["images"] = np.full_like(batch["images"], np.nan)
+                yield batch
+
+        state = trainer.init_state()
+        with pytest.raises(FloatingPointError):
+            trainer.fit(nan_batches(), num_steps=2, state=state)

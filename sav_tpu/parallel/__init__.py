@@ -1,9 +1,11 @@
 from sav_tpu.parallel.mesh import (
     DATA_AXIS,
     EXPERT_AXIS,
+    FSDP_AXIS,
     MODEL_AXIS,
     PIPE_AXIS,
     SEQ_AXIS,
+    batch_axes,
     batch_sharding,
     create_mesh,
     distributed_init,
@@ -17,6 +19,7 @@ from sav_tpu.parallel.pipelining import (
 from sav_tpu.parallel.ring_attention import ring_attention
 from sav_tpu.parallel.sharding import (
     DEFAULT_TP_RULES,
+    add_fsdp_axis,
     param_path_specs,
     param_shardings,
     shard_params,
@@ -24,6 +27,7 @@ from sav_tpu.parallel.sharding import (
 
 __all__ = [
     "DATA_AXIS",
+    "FSDP_AXIS",
     "EXPERT_AXIS",
     "MODEL_AXIS",
     "PIPE_AXIS",
@@ -31,11 +35,13 @@ __all__ = [
     "pipeline",
     "stack_stage_params",
     "stage_param_shardings",
+    "batch_axes",
     "batch_sharding",
     "create_mesh",
     "distributed_init",
     "replicated",
     "DEFAULT_TP_RULES",
+    "add_fsdp_axis",
     "param_path_specs",
     "param_shardings",
     "shard_params",

@@ -16,10 +16,12 @@ import jax
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-# Canonical axis names. data = batch (DP), model = tensor parallel (TP),
-# seq = sequence/context parallel (ring attention), pipe = pipeline stages,
-# expert = MoE expert parallelism.
+# Canonical axis names. data = batch (DP), fsdp = batch + parameter sharding
+# (ZeRO-3 style), model = tensor parallel (TP), seq = sequence/context
+# parallel (ring attention), pipe = pipeline stages, expert = MoE expert
+# parallelism.
 DATA_AXIS = "data"
+FSDP_AXIS = "fsdp"
 MODEL_AXIS = "model"
 SEQ_AXIS = "seq"
 PIPE_AXIS = "pipe"
@@ -96,9 +98,16 @@ def create_mesh(
     return Mesh(dev_array, names)
 
 
+def batch_axes(mesh: Mesh) -> tuple[str, ...]:
+    """Mesh axes the batch dimension shards over: ``data`` and (when present)
+    ``fsdp`` — FSDP is batch-parallel for activations, parameter-sharded for
+    weights."""
+    return tuple(a for a in (DATA_AXIS, FSDP_AXIS) if a in mesh.axis_names)
+
+
 def batch_sharding(mesh: Mesh) -> NamedSharding:
-    """Shard the leading (batch) dim over the data axis, replicate the rest."""
-    return NamedSharding(mesh, P(DATA_AXIS))
+    """Shard the leading (batch) dim over the batch axes, replicate the rest."""
+    return NamedSharding(mesh, P(batch_axes(mesh)))
 
 
 def replicated(mesh: Mesh) -> NamedSharding:

@@ -25,7 +25,7 @@ from typing import Any
 import jax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from sav_tpu.parallel.mesh import MODEL_AXIS
+from sav_tpu.parallel.mesh import FSDP_AXIS, MODEL_AXIS
 
 # (path regex, partition spec builder taking the param ndim)
 DEFAULT_TP_RULES: list[tuple[str, Any]] = [
@@ -61,19 +61,71 @@ def param_path_specs(
     return jax.tree_util.tree_unflatten(treedef, specs)
 
 
+def add_fsdp_axis(
+    spec: Any, shape: tuple[int, ...], fsdp_size: int, *, min_elements: int
+) -> Any:
+    """Augment a PartitionSpec with FSDP sharding (ZeRO-3 style).
+
+    Shards the largest not-already-sharded dimension divisible by
+    ``fsdp_size`` over the ``fsdp`` axis. Small tensors (< ``min_elements``)
+    stay replicated — sharding tiny norm scales/biases costs more in
+    collective latency than it saves in HBM.
+    """
+    import numpy as np
+
+    if int(np.prod(shape)) < min_elements:
+        return spec
+    entries = list(spec) + [None] * (len(shape) - len(spec))
+    candidates = [
+        (shape[i], i)
+        for i, e in enumerate(entries)
+        if e is None and shape[i] % fsdp_size == 0 and shape[i] >= fsdp_size
+    ]
+    if not candidates:
+        return spec
+    _, dim = max(candidates)
+    entries[dim] = FSDP_AXIS
+    return P(*entries)
+
+
 def param_shardings(
-    params: Any, mesh: Mesh, rules: list[tuple[str, Any]] | None = None
+    params: Any,
+    mesh: Mesh,
+    rules: list[tuple[str, Any]] | None = None,
+    *,
+    fsdp_min_elements: int = 2**16,
 ) -> Any:
     """Tree of ``NamedSharding`` for ``params``.
 
-    With no ``model`` axis in the mesh (pure DP) the *default* rules are
+    With no ``model`` axis in the mesh (pure DP) the *default* TP rules are
     skipped (everything replicates). Caller-supplied rules are always
-    honored — they may target other mesh axes (e.g. ``seq``).
+    honored — they may target other mesh axes (e.g. ``seq``). When the mesh
+    has an ``fsdp`` axis, every large parameter is additionally sharded over
+    it (largest free dimension) — under jit the partitioner inserts the
+    per-layer all-gathers and reduce-scatters this implies.
     """
     if rules is None:
         rules = DEFAULT_TP_RULES if MODEL_AXIS in mesh.axis_names else []
     specs = param_path_specs(params, rules)
-    return jax.tree.map(lambda s: NamedSharding(mesh, s), specs)
+    if FSDP_AXIS in mesh.axis_names:
+        fsdp_size = mesh.shape[FSDP_AXIS]
+        flat = jax.tree_util.tree_flatten_with_path(params)[0]
+        spec_leaves = jax.tree.leaves(
+            specs, is_leaf=lambda x: isinstance(x, P)
+        )
+        new_leaves = [
+            add_fsdp_axis(s, leaf.shape, fsdp_size, min_elements=fsdp_min_elements)
+            for s, (_, leaf) in zip(spec_leaves, flat)
+        ]
+        treedef = jax.tree_util.tree_structure(
+            specs, is_leaf=lambda x: isinstance(x, P)
+        )
+        specs = jax.tree_util.tree_unflatten(treedef, new_leaves)
+    return jax.tree.map(
+        lambda s: NamedSharding(mesh, s),
+        specs,
+        is_leaf=lambda x: isinstance(x, P),
+    )
 
 
 def shard_params(params: Any, mesh: Mesh, rules=None) -> Any:

@@ -5,6 +5,7 @@ from sav_tpu.train.optimizer import (
     warmup_cosine_schedule,
     weight_decay_mask,
 )
+from sav_tpu.train.presets import get_preset, preset_names, register_preset
 from sav_tpu.train.state import TrainState
 from sav_tpu.train.trainer import Trainer
 
@@ -16,4 +17,7 @@ __all__ = [
     "make_optimizer",
     "warmup_cosine_schedule",
     "weight_decay_mask",
+    "get_preset",
+    "preset_names",
+    "register_preset",
 ]

@@ -26,12 +26,13 @@ import optax
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from sav_tpu.models import create_model
-from sav_tpu.parallel.mesh import DATA_AXIS, create_mesh
+from sav_tpu.parallel.mesh import batch_axes, create_mesh
 from sav_tpu.parallel.sharding import param_shardings
 from sav_tpu.train.checkpoint import Checkpointer
 from sav_tpu.train.config import TrainConfig
 from sav_tpu.train.optimizer import make_optimizer, warmup_cosine_schedule
 from sav_tpu.train.state import TrainState
+from sav_tpu.utils import profiler
 from sav_tpu.utils.debug import assert_all_finite
 from sav_tpu.utils.metrics import cross_entropy, topk_correct
 
@@ -207,10 +208,12 @@ class Trainer:
     def train_many_steps(self, state: TrainState, batches: dict, rng: jax.Array):
         """Run ``K`` steps fused on-device; see ``_train_many_impl``."""
 
+        baxes = batch_axes(self.mesh)
+
         def sharding_for(key, leaf):
             if key == "images" and self.config.transpose_images and leaf.ndim == 5:
-                return NamedSharding(self.mesh, P(None, None, None, None, DATA_AXIS))
-            return NamedSharding(self.mesh, P(None, DATA_AXIS))
+                return NamedSharding(self.mesh, P(None, None, None, None, baxes))
+            return NamedSharding(self.mesh, P(None, baxes))
 
         placed = {k: jax.device_put(v, sharding_for(k, v)) for k, v in batches.items()}
         return self._train_many(state, placed, rng)
@@ -238,10 +241,12 @@ class Trainer:
     def shard_batch(self, batch: dict) -> dict:
         """Place a host batch onto the mesh, batch dim over the data axis."""
 
+        baxes = batch_axes(self.mesh)
+
         def sharding_for(key, leaf):
             if key == "images" and self.config.transpose_images and leaf.ndim == 4:
-                return NamedSharding(self.mesh, P(None, None, None, DATA_AXIS))
-            return NamedSharding(self.mesh, P(DATA_AXIS))
+                return NamedSharding(self.mesh, P(None, None, None, baxes))
+            return NamedSharding(self.mesh, P(baxes))
 
         return {
             k: jax.device_put(v, sharding_for(k, v)) for k, v in batch.items()
@@ -307,11 +312,16 @@ class Trainer:
         try:
             for step, batch in zip(range(start_step, num_steps), train_iter):
                 if cfg.profile_dir is not None:
+                    # Steps dispatch asynchronously: sync the device at both
+                    # window edges so the trace covers exactly the intended
+                    # steps, not a few ms of host dispatch.
                     if not profiling and prof_start <= step < prof_stop:
-                        jax.profiler.start_trace(cfg.profile_dir)
+                        jax.block_until_ready(state)
+                        profiler.start_trace(cfg.profile_dir)
                         profiling = True
                     elif profiling and step >= prof_stop:
-                        jax.profiler.stop_trace()
+                        jax.block_until_ready(state)
+                        profiler.stop_trace()
                         profiling = False
                 state, metrics = self.train_step(state, batch, rng)
                 if cfg.debug_nans:
@@ -350,7 +360,7 @@ class Trainer:
                     last_logged_step = step + 1
         finally:
             if profiling:
-                jax.profiler.stop_trace()
+                profiler.stop_trace()
         if self.checkpointer is not None:
             if last_saved_step != num_steps:
                 self.checkpointer.save(num_steps, state)

@@ -31,9 +31,11 @@ def find_nonfinite(tree: Any) -> list[str]:
     for path, leaf in jax.tree_util.tree_flatten_with_path(host)[0]:
         # jnp.issubdtype, not numpy dtype.kind: bfloat16 (ml_dtypes) has
         # kind 'V' and would silently pass a kind=='f' check.
-        if not jnp.issubdtype(np.asarray(leaf).dtype, jnp.floating):
+        arr = np.asarray(leaf)
+        if not jnp.issubdtype(arr.dtype, jnp.floating):
             continue
-        arr = np.asarray(leaf, dtype=np.float32)
+        if arr.dtype.kind != "f":  # ml_dtypes (bfloat16, float8_*) → upcast
+            arr = arr.astype(np.float32)
         if not np.isfinite(arr).all():
             bad.append(_path_str(path))
     return bad

@@ -23,16 +23,10 @@ import jax
 import numpy as np
 
 
-@contextlib.contextmanager
-def trace(log_dir: Optional[str], *, host_tracer_level: int = 2):
-    """Capture a ``jax.profiler`` trace into ``log_dir``.
-
-    No-op when ``log_dir`` is None so call sites can leave the hook wired
-    unconditionally (``with trace(cfg.profile_dir): step()``).
-    """
-    if log_dir is None:
-        yield
-        return
+def start_trace(log_dir: str, *, host_tracer_level: int = 2) -> None:
+    """``jax.profiler.start_trace`` with host-tracer options when the
+    running jax supports them (single implementation for the context
+    manager and the trainer's step-window profiling)."""
     options = None
     try:  # ProfileOptions is a recent jax addition; fall back silently.
         options = jax.profiler.ProfileOptions()
@@ -44,10 +38,27 @@ def trace(log_dir: Optional[str], *, host_tracer_level: int = 2):
         jax.profiler.start_trace(log_dir, **kwargs)
     except TypeError:  # older signature without profiler_options
         jax.profiler.start_trace(log_dir)
+
+
+def stop_trace() -> None:
+    jax.profiler.stop_trace()
+
+
+@contextlib.contextmanager
+def trace(log_dir: Optional[str], *, host_tracer_level: int = 2):
+    """Capture a ``jax.profiler`` trace into ``log_dir``.
+
+    No-op when ``log_dir`` is None so call sites can leave the hook wired
+    unconditionally (``with trace(cfg.profile_dir): step()``).
+    """
+    if log_dir is None:
+        yield
+        return
+    start_trace(log_dir, host_tracer_level=host_tracer_level)
     try:
         yield
     finally:
-        jax.profiler.stop_trace()
+        stop_trace()
 
 
 def annotate(name: str):

@@ -1,0 +1,37 @@
+"""Experiment presets (jaxline-config parity, SURVEY.md §2.6)."""
+
+import pytest
+
+from sav_tpu.models import model_names
+from sav_tpu.train import TrainConfig, get_preset, preset_names
+
+
+def test_all_presets_build_valid_configs():
+    names = preset_names()
+    assert "botnet_t3_imagenet" in names and "deit_s_imagenet" in names
+    for name in names:
+        cfg = get_preset(name)
+        assert isinstance(cfg, TrainConfig)
+        assert cfg.model_name in model_names()
+        assert cfg.total_steps > 0
+
+
+def test_botnet_t3_matches_reference_recipe():
+    # /root/reference/experiments/BoTNet/botnet_t3_imagenet.py:36-60
+    cfg = get_preset("botnet_t3_imagenet")
+    assert cfg.model_name == "botnet_t3"
+    assert cfg.global_batch_size == 2048
+    assert cfg.num_epochs == 300
+    assert cfg.weight_decay == 0.05
+    assert cfg.compute_dtype == "bfloat16"
+    assert cfg.augment == "cutmix_mixup_randaugment_405"
+    assert cfg.learning_rate == pytest.approx(1e-3)
+
+
+def test_overrides_and_errors():
+    cfg = get_preset("deit_s_imagenet", global_batch_size=256, checkpoint_dir="/tmp/x")
+    assert cfg.global_batch_size == 256 and cfg.checkpoint_dir == "/tmp/x"
+    with pytest.raises(ValueError, match="unknown preset"):
+        get_preset("nope")
+    with pytest.raises(TypeError, match="invalid TrainConfig fields"):
+        get_preset("deit_s_imagenet", not_a_field=1)

@@ -38,23 +38,35 @@ import click
 @click.option("--backend", type=click.Choice(["auto", "xla", "pallas"]), default="auto")
 @click.option("--dtype", type=click.Choice(["bfloat16", "float32"]), default="bfloat16")
 @click.option("--tp", type=int, default=1, help="Tensor-parallel mesh axis size.")
+@click.option("--fsdp", type=int, default=1, help="FSDP mesh axis size (params sharded).")
+@click.option(
+    "--preset", type=str, default=None,
+    help="Named experiment preset (sav_tpu.train.presets); CLI flags override.",
+)
 @click.option("-c", "--checkpoint-dir", type=str, default=None)
 @click.option("--steps", type=int, default=None, help="Override total steps.")
 @click.option("--seed", type=int, default=42)
+@click.pass_context
 def main(
-    data_dir, fake_data, model_name, num_classes, image_size, batch_size,
+    ctx, data_dir, fake_data, model_name, num_classes, image_size, batch_size,
     num_epochs, learning_rate, weight_decay, label_smoothing, clip_grad,
-    augmentation, backend, dtype, tp, checkpoint_dir, steps, seed,
+    augmentation, backend, dtype, tp, fsdp, preset, checkpoint_dir, steps, seed,
 ):
     import jax
 
     from sav_tpu.data.pipeline import Split, load
-    from sav_tpu.parallel import create_mesh, distributed_init
-    from sav_tpu.train import TrainConfig, Trainer
+    from sav_tpu.parallel import distributed_init
+    from sav_tpu.train import TrainConfig, Trainer, get_preset
 
     distributed_init()
     n_devices = len(jax.devices())
-    mesh_axes = {"data": n_devices // tp, "model": tp} if tp > 1 else None
+    mesh_axes = None
+    if tp > 1 or fsdp > 1:
+        mesh_axes = {"data": n_devices // (tp * fsdp)}
+        if fsdp > 1:
+            mesh_axes["fsdp"] = fsdp
+        if tp > 1:
+            mesh_axes["model"] = tp
 
     config = TrainConfig(
         model_name=model_name,
@@ -73,6 +85,40 @@ def main(
         checkpoint_dir=checkpoint_dir,
         seed=seed,
     )
+    if preset is not None:
+        # Preset supplies the recipe; flags the user explicitly passed on the
+        # command line override it.
+        explicit = {
+            name
+            for name in ctx.params
+            if ctx.get_parameter_source(name) == click.core.ParameterSource.COMMANDLINE
+        }
+        flag_to_field = {
+            "model_name": "model_name", "num_classes": "num_classes",
+            "image_size": "image_size", "dtype": "compute_dtype",
+            "batch_size": "global_batch_size", "augmentation": "augment",
+            "num_epochs": "num_epochs", "learning_rate": "base_lr",
+            "weight_decay": "weight_decay", "label_smoothing": "label_smoothing",
+            "clip_grad": "clip_grad_norm", "checkpoint_dir": "checkpoint_dir",
+            "seed": "seed",
+        }
+        overrides = {
+            field: getattr(config, field)
+            for flag, field in flag_to_field.items()
+            if flag in explicit
+        }
+        if "backend" in explicit:
+            overrides["attention_backend"] = None if backend == "auto" else backend
+        if mesh_axes is not None:
+            overrides["mesh_axes"] = mesh_axes
+        config = get_preset(preset, **overrides)
+    # Refresh locals the data pipeline uses from the final config.
+    model_name = config.model_name
+    image_size = config.image_size
+    batch_size = config.global_batch_size
+    augmentation = config.augment
+    dtype = config.compute_dtype
+    seed = config.seed
     if jax.process_index() == 0:
         click.echo(config.to_json())
 
@@ -102,7 +148,7 @@ def main(
             fake_data=fake_data,
         )
 
-    trainer = Trainer(config, mesh=create_mesh(mesh_axes))
+    trainer = Trainer(config)
 
     def log_fn(metrics):
         if jax.process_index() == 0:

@@ -17,7 +17,9 @@ from sav_tpu.parallel.pipelining import (
     stage_param_shardings,
 )
 from sav_tpu.parallel.ring_attention import ring_attention
+from sav_tpu.parallel.ulysses import ulysses_attention
 from sav_tpu.parallel.sharding import (
+    DEFAULT_EP_RULES,
     DEFAULT_TP_RULES,
     add_fsdp_axis,
     param_path_specs,
@@ -40,10 +42,12 @@ __all__ = [
     "create_mesh",
     "distributed_init",
     "replicated",
+    "DEFAULT_EP_RULES",
     "DEFAULT_TP_RULES",
     "add_fsdp_axis",
     "param_path_specs",
     "param_shardings",
     "shard_params",
     "ring_attention",
+    "ulysses_attention",
 ]

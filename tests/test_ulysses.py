@@ -1,0 +1,85 @@
+"""Ulysses (all-to-all) sequence parallelism vs dense XLA attention."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from sav_tpu.ops import xla_attention
+from sav_tpu.parallel import create_mesh
+from sav_tpu.parallel.ulysses import ulysses_attention
+
+
+def _qkv(b=2, l=256, h=8, d=32, dtype=jnp.float32):
+    ks = jax.random.split(jax.random.PRNGKey(0), 3)
+    return tuple(jax.random.normal(k, (b, l, h, d), dtype) for k in ks)
+
+
+def test_ulysses_matches_dense(devices):
+    mesh = create_mesh({"seq": 8})
+    q, k, v = _qkv()
+    ref = xla_attention(q, k, v)
+    out = ulysses_attention(q, k, v, mesh=mesh)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5, rtol=2e-5)
+
+
+def test_ulysses_with_batch_axis(devices):
+    mesh = create_mesh({"data": 2, "seq": 4})
+    q, k, v = _qkv(b=4, l=128)
+    ref = xla_attention(q, k, v)
+    out = ulysses_attention(q, k, v, mesh=mesh, batch_axis="data")
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5, rtol=2e-5)
+
+
+def test_ulysses_gradients_match(devices):
+    mesh = create_mesh({"seq": 4}, devices=jax.devices()[:4])
+    q, k, v = _qkv(l=64)
+
+    def loss_ulysses(q, k, v):
+        return jnp.sum(jnp.square(ulysses_attention(q, k, v, mesh=mesh)))
+
+    def loss_dense(q, k, v):
+        return jnp.sum(jnp.square(xla_attention(q, k, v)))
+
+    gu = jax.grad(loss_ulysses, argnums=(0, 1, 2))(q, k, v)
+    gd = jax.grad(loss_dense, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(gu, gd):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=5e-5, rtol=5e-4)
+
+
+def test_ulysses_sharded_inputs_stay_sharded(devices):
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    mesh = create_mesh({"seq": 8})
+    q, k, v = _qkv(b=1, l=1024, h=8, d=64)
+    sh = NamedSharding(mesh, P(None, "seq", None, None))
+    qs, ks, vs = (jax.device_put(x, sh) for x in (q, k, v))
+    out = jax.jit(lambda q, k, v: ulysses_attention(q, k, v, mesh=mesh))(qs, ks, vs)
+    assert out.sharding.spec == P(None, "seq", None, None)
+    ref = xla_attention(q, k, v)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=5e-5, rtol=5e-5)
+
+
+def test_ulysses_rejects_indivisible_heads(devices):
+    mesh = create_mesh({"seq": 8})
+    q, k, v = _qkv(h=4)
+    with pytest.raises(ValueError, match="head count"):
+        ulysses_attention(q, k, v, mesh=mesh)
+
+
+def test_ulysses_rejects_indivisible_length(devices):
+    mesh = create_mesh({"seq": 8})
+    q, k, v = _qkv(l=100)
+    with pytest.raises(ValueError, match="not divisible"):
+        ulysses_attention(q, k, v, mesh=mesh)
+
+
+def test_ulysses_bf16(devices):
+    mesh = create_mesh({"seq": 4}, devices=jax.devices()[:4])
+    q, k, v = _qkv(l=128, dtype=jnp.bfloat16)
+    ref = xla_attention(q, k, v)
+    out = ulysses_attention(q, k, v, mesh=mesh)
+    assert out.dtype == jnp.bfloat16
+    np.testing.assert_allclose(
+        np.asarray(out, np.float32), np.asarray(ref, np.float32), atol=3e-2, rtol=3e-2
+    )

@@ -16,6 +16,7 @@ from sav_tpu.models.layers.cvt_attention import (
     CvTSelfAttentionBlock,
 )
 from sav_tpu.models.layers.feedforward import FFBlock, LeFFBlock
+from sav_tpu.models.layers.moe import MoEFFBlock
 from sav_tpu.models.layers.normalization import LayerScaleBlock
 from sav_tpu.models.layers.position_embed import (
     AddAbsPosEmbed,
@@ -38,6 +39,7 @@ __all__ = [
     "CvTSelfAttentionBlock",
     "FFBlock",
     "LeFFBlock",
+    "MoEFFBlock",
     "LayerScaleBlock",
     "AddAbsPosEmbed",
     "FixedPositionalEmbedding",

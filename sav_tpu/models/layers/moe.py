@@ -1,0 +1,123 @@
+"""Mixture-of-Experts feed-forward with expert parallelism.
+
+Capability headroom beyond the reference (which has no MoE/EP —
+SURVEY.md §2.7): a token-choice top-k routed FF block designed for the TPU
+partitioner. Dispatch and combine are dense one-hot einsums over static
+``[groups, tokens/group, experts, capacity]`` tensors — no scatter/gather,
+no dynamic shapes, so XLA tiles everything onto the MXU and, with the expert weights
+sharded ``P('expert', ...)`` (``sav_tpu.parallel.sharding.DEFAULT_EP_RULES``),
+inserts the dispatch/return all-to-alls over ICI on its own.
+
+Router math runs in fp32 regardless of compute dtype (routing decisions are
+precision-sensitive); a Switch-Transformer-style load-balancing loss is
+sown into the ``'losses'`` collection as ``moe_aux_loss`` for the trainer
+to pick up.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Any, Callable, Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+Dtype = Any
+
+
+class MoEFFBlock(nn.Module):
+    """Token-choice top-k mixture-of-experts transformer MLP.
+
+    Drop-in replacement for :class:`FFBlock` on ``[B, L, D]`` token inputs.
+    Each batch row is a routing group (GShard-style): tokens pick their
+    top-``top_k`` experts, and each expert accepts at most
+    ``capacity_factor · k · L / E`` tokens *per group* — overflow tokens
+    fall through the residual unmodified (standard Switch/GShard behavior),
+    and the dispatch tensors stay linear in total token count.
+    """
+
+    num_experts: int
+    top_k: int = 2
+    capacity_factor: float = 1.25
+    expand_ratio: Optional[float] = 4.0
+    hidden_ch: Optional[int] = None
+    dropout_rate: float = 0.0
+    activation_fn: Callable = nn.gelu
+    dtype: Dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, inputs: jax.Array, is_training: bool) -> jax.Array:
+        g, s, d = inputs.shape  # groups (batch rows) × tokens/group × dim
+        hidden = self.hidden_ch or int(d * self.expand_ratio)
+        n_exp, k = self.num_experts, self.top_k
+        if not 1 <= k <= n_exp:
+            raise ValueError(f"top_k={k} must be in [1, num_experts={n_exp}]")
+        x = inputs
+
+        # --- Router (fp32) -------------------------------------------------
+        router = self.param(
+            "router", nn.initializers.normal(stddev=0.02), (d, n_exp)
+        )
+        logits = jnp.einsum(
+            "gsd,de->gse",
+            x.astype(jnp.float32),
+            router.astype(jnp.float32),
+            preferred_element_type=jnp.float32,
+        )
+        probs = jax.nn.softmax(logits, axis=-1)
+        gate_vals, expert_idx = jax.lax.top_k(probs, k)  # [G, S, k] each
+        gate_vals = gate_vals / jnp.sum(gate_vals, axis=-1, keepdims=True)
+
+        # Load-balancing aux loss (Switch eq. 4), over all tokens globally:
+        # E · Σ_e f_e · P_e where f_e = fraction of tokens whose top-1 choice
+        # is e, P_e = mean router probability for e. Minimized (=1) by a
+        # uniform router.
+        top1_frac = jnp.mean(jax.nn.one_hot(expert_idx[..., 0], n_exp), axis=(0, 1))
+        aux_loss = n_exp * jnp.sum(top1_frac * jnp.mean(probs, axis=(0, 1)))
+        self.sow("losses", "moe_aux_loss", aux_loss)
+
+        # --- Capacity-based dispatch/combine, GShard-style grouped --------
+        # Capacity is per *group* (each batch row routes independently), so
+        # the dispatch tensors are [G, S, E, C] with C ∝ S/E — total memory
+        # and FLOPs stay linear in token count instead of quadratic.
+        capacity = max(k, math.ceil(self.capacity_factor * k * s / n_exp))
+        counts = jnp.zeros((g, n_exp), jnp.int32)
+        dispatch = jnp.zeros((g, s, n_exp, capacity), jnp.float32)
+        combine = jnp.zeros((g, s, n_exp, capacity), jnp.float32)
+        for slot in range(k):  # k is static and tiny — unrolled
+            onehot = jax.nn.one_hot(expert_idx[..., slot], n_exp, dtype=jnp.int32)
+            # Position of each token in its expert's buffer: running
+            # per-(group, expert) count from earlier slots + cumulative count
+            # within this slot. one_hot maps positions ≥ capacity to the
+            # all-zero row, which is exactly the overflow-drop semantics.
+            pos = jnp.cumsum(onehot, axis=1) - 1 + counts[:, None, :]
+            pos_tok = jnp.sum(pos * onehot, axis=-1)  # [G, S]
+            slot_mask = (
+                onehot.astype(jnp.float32)[..., None]
+                * jax.nn.one_hot(pos_tok, capacity)[..., None, :]
+            )
+            dispatch = dispatch + slot_mask
+            combine = combine + slot_mask * gate_vals[..., slot][..., None, None]
+            counts = counts + jnp.sum(onehot, axis=1)
+
+        # --- Expert computation (batched over the expert dim) -------------
+        fan_init = nn.initializers.variance_scaling(1.0, "fan_in", "truncated_normal")
+        w1 = self.param("experts_w1", fan_init, (n_exp, d, hidden))
+        b1 = self.param("experts_b1", nn.initializers.zeros, (n_exp, hidden))
+        w2 = self.param("experts_w2", fan_init, (n_exp, hidden, d))
+        b2 = self.param("experts_b2", nn.initializers.zeros, (n_exp, d))
+
+        cdt = self.dtype
+        xe = jnp.einsum("gsec,gsd->egcd", dispatch.astype(cdt), x.astype(cdt))
+        h = self.activation_fn(
+            jnp.einsum("egcd,edh->egch", xe, w1.astype(cdt))
+            + b1.astype(cdt)[:, None, None, :]
+        )
+        h = nn.Dropout(rate=self.dropout_rate)(h, deterministic=not is_training)
+        ye = jnp.einsum("egch,ehd->egcd", h, w2.astype(cdt)) + b2.astype(cdt)[
+            :, None, None, :
+        ]
+        y = jnp.einsum("gsec,egcd->gsd", combine.astype(cdt), ye)
+        y = nn.Dropout(rate=self.dropout_rate)(y, deterministic=not is_training)
+        return y.astype(inputs.dtype)

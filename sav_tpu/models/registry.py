@@ -51,6 +51,15 @@ register("vit_b_patch32", ViT, **_vit(768, 12, 12, 32))
 register("vit_b_patch16", ViT, **_vit(768, 12, 12, 16))
 register("vit_l_patch32", ViT, **_vit(1024, 24, 16, 32))
 register("vit_l_patch16", ViT, **_vit(1024, 24, 16, 16))
+# MoE variant (beyond reference parity): DeiT-S trunk with a top-2-routed
+# 8-expert FF on every other block; experts shard over the 'expert' mesh axis.
+register(
+    "vit_moe_s_patch16_e8",
+    ViT,
+    **_vit(384, 12, 6, 16),
+    moe_num_experts=8,
+    moe_top_k=2,
+)
 
 # --- BoTNet (create_model.py:38-49) ----------------------------------------
 register("botnet_t3", BoTNet, stage_sizes=(3, 4, 6, 6))

@@ -19,6 +19,7 @@ from sav_tpu.models.layers import (
     PatchEmbedBlock,
     SelfAttentionBlock,
 )
+from sav_tpu.models.layers.moe import MoEFFBlock
 
 Dtype = Any
 
@@ -30,6 +31,8 @@ class EncoderBlock(nn.Module):
     expand_ratio: float = 4.0
     attn_dropout_rate: float = 0.0
     dropout_rate: float = 0.0
+    moe_num_experts: Optional[int] = None  # MoE FF instead of dense FF
+    moe_top_k: int = 2
     backend: Optional[str] = None
     dtype: Dtype = jnp.float32
 
@@ -45,11 +48,20 @@ class EncoderBlock(nn.Module):
         )(x, is_training)
         x = x + inputs
         y = nn.LayerNorm(dtype=self.dtype)(x)
-        y = FFBlock(
-            expand_ratio=self.expand_ratio,
-            dropout_rate=self.dropout_rate,
-            dtype=self.dtype,
-        )(y, is_training)
+        if self.moe_num_experts:
+            y = MoEFFBlock(
+                num_experts=self.moe_num_experts,
+                top_k=self.moe_top_k,
+                expand_ratio=self.expand_ratio,
+                dropout_rate=self.dropout_rate,
+                dtype=self.dtype,
+            )(y, is_training)
+        else:
+            y = FFBlock(
+                expand_ratio=self.expand_ratio,
+                dropout_rate=self.dropout_rate,
+                dtype=self.dtype,
+            )(y, is_training)
         return x + y
 
 
@@ -61,6 +73,9 @@ class Encoder(nn.Module):
     expand_ratio: float = 4.0
     attn_dropout_rate: float = 0.0
     dropout_rate: float = 0.0
+    moe_num_experts: Optional[int] = None
+    moe_top_k: int = 2
+    moe_every: int = 2  # MoE FF on every moe_every-th block (GShard-style)
     backend: Optional[str] = None
     dtype: Dtype = jnp.float32
 
@@ -69,11 +84,16 @@ class Encoder(nn.Module):
         x = AddAbsPosEmbed(dtype=self.dtype)(inputs)
         x = nn.Dropout(rate=self.dropout_rate)(x, deterministic=not is_training)
         for i in range(self.num_layers):
+            is_moe = bool(self.moe_num_experts) and i % self.moe_every == (
+                self.moe_every - 1
+            )
             x = EncoderBlock(
                 num_heads=self.num_heads,
                 expand_ratio=self.expand_ratio,
                 attn_dropout_rate=self.attn_dropout_rate,
                 dropout_rate=self.dropout_rate,
+                moe_num_experts=self.moe_num_experts if is_moe else None,
+                moe_top_k=self.moe_top_k,
                 backend=self.backend,
                 dtype=self.dtype,
                 name=f"block_{i}",
@@ -92,6 +112,9 @@ class ViT(nn.Module):
     expand_ratio: float = 4.0
     attn_dropout_rate: float = 0.0
     dropout_rate: float = 0.0
+    moe_num_experts: Optional[int] = None
+    moe_top_k: int = 2
+    moe_every: int = 2
     backend: Optional[str] = None
     dtype: Dtype = jnp.float32
 
@@ -110,6 +133,9 @@ class ViT(nn.Module):
             expand_ratio=self.expand_ratio,
             attn_dropout_rate=self.attn_dropout_rate,
             dropout_rate=self.dropout_rate,
+            moe_num_experts=self.moe_num_experts,
+            moe_top_k=self.moe_top_k,
+            moe_every=self.moe_every,
             backend=self.backend,
             dtype=self.dtype,
         )(x, is_training)

@@ -25,7 +25,7 @@ from typing import Any
 import jax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from sav_tpu.parallel.mesh import FSDP_AXIS, MODEL_AXIS
+from sav_tpu.parallel.mesh import EXPERT_AXIS, FSDP_AXIS, MODEL_AXIS
 
 # (path regex, partition spec builder taking the param ndim)
 DEFAULT_TP_RULES: list[tuple[str, Any]] = [
@@ -37,6 +37,14 @@ DEFAULT_TP_RULES: list[tuple[str, Any]] = [
     (r"(fc1|expand)/kernel$", P(None, MODEL_AXIS)),
     (r"(fc1|expand)/bias$", P(MODEL_AXIS)),
     (r"(fc2|project)/kernel$", P(MODEL_AXIS, None)),
+]
+
+# Expert parallelism: MoE expert weights carry a leading expert dimension
+# sharded over the 'expert' mesh axis (router stays replicated). Applied
+# automatically when the mesh has that axis.
+DEFAULT_EP_RULES: list[tuple[str, Any]] = [
+    (r"experts_(w1|w2)$", P(EXPERT_AXIS, None, None)),
+    (r"experts_(b1|b2)$", P(EXPERT_AXIS, None)),
 ]
 
 
@@ -97,15 +105,20 @@ def param_shardings(
 ) -> Any:
     """Tree of ``NamedSharding`` for ``params``.
 
-    With no ``model`` axis in the mesh (pure DP) the *default* TP rules are
-    skipped (everything replicates). Caller-supplied rules are always
+    Default rules are chosen from the mesh: TP rules when a ``model`` axis
+    is present, EP rules when an ``expert`` axis is present, otherwise
+    everything replicates (pure DP). Caller-supplied rules are always
     honored — they may target other mesh axes (e.g. ``seq``). When the mesh
     has an ``fsdp`` axis, every large parameter is additionally sharded over
     it (largest free dimension) — under jit the partitioner inserts the
     per-layer all-gathers and reduce-scatters this implies.
     """
     if rules is None:
-        rules = DEFAULT_TP_RULES if MODEL_AXIS in mesh.axis_names else []
+        rules = []
+        if EXPERT_AXIS in mesh.axis_names:
+            rules = rules + DEFAULT_EP_RULES
+        if MODEL_AXIS in mesh.axis_names:
+            rules = rules + DEFAULT_TP_RULES
     specs = param_path_specs(params, rules)
     if FSDP_AXIS in mesh.axis_names:
         fsdp_size = mesh.shape[FSDP_AXIS]

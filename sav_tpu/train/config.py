@@ -39,6 +39,7 @@ class TrainConfig:
     weight_decay: float = 0.05
     clip_grad_norm: Optional[float] = 1.0
     label_smoothing: float = 0.1
+    aux_loss_weight: float = 0.01  # weight on sown 'losses' (MoE balance etc.)
     seed: int = 42
 
     # Mesh: axis name -> size (-1 absorbs remaining devices)

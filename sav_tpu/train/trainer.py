@@ -154,22 +154,31 @@ class Trainer:
             variables = {"params": params}
             if has_bn:
                 variables["batch_stats"] = state.batch_stats
-            out = self.model.apply(
+            # 'losses' collects auxiliary objectives modules sow (e.g. the
+            # MoE load-balancing loss); empty for most models.
+            mutable = ["batch_stats", "losses"] if has_bn else ["losses"]
+            logits, new_vars = self.model.apply(
                 variables,
                 images,
                 is_training=True,
                 rngs={"dropout": dropout_rng, "stochastic_depth": sd_rng},
-                mutable=["batch_stats"] if has_bn else False,
+                mutable=mutable,
             )
-            if has_bn:
-                logits, new_vars = out
-                new_batch_stats = new_vars["batch_stats"]
-            else:
-                logits, new_batch_stats = out, state.batch_stats
-            loss = cross_entropy(logits, label_probs)
-            return loss, (logits, new_batch_stats)
+            new_batch_stats = (
+                new_vars["batch_stats"] if has_bn else state.batch_stats
+            )
+            aux = sum(
+                jnp.sum(leaf)
+                for leaf in jax.tree.leaves(new_vars.get("losses", {}))
+            )
+            aux = jnp.asarray(aux, jnp.float32)
+            loss = (
+                cross_entropy(logits, label_probs)
+                + self.config.aux_loss_weight * aux
+            )
+            return loss, (logits, new_batch_stats, aux)
 
-        (loss, (logits, new_batch_stats)), grads = jax.value_and_grad(
+        (loss, (logits, new_batch_stats, aux_loss)), grads = jax.value_and_grad(
             loss_fn, has_aux=True
         )(state.params)
         updates, new_opt_state = self.tx.update(grads, state.opt_state, state.params)
@@ -187,6 +196,7 @@ class Trainer:
             "top_5_acc": jnp.mean(acc["top_5_acc"]),
             "learning_rate": self.schedule(state.step),
             "grad_norm": optax.global_norm(grads),
+            "aux_loss": aux_loss,
         }
         return new_state, metrics
 

@@ -1,0 +1,140 @@
+"""Mixture-of-Experts FF block + expert-parallel sharding."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from sav_tpu.models import create_model
+from sav_tpu.models.layers.moe import MoEFFBlock
+from sav_tpu.parallel import create_mesh, param_shardings, shard_params
+
+
+def _block(**kw):
+    defaults = dict(num_experts=4, top_k=2, expand_ratio=2.0)
+    defaults.update(kw)
+    return MoEFFBlock(**defaults)
+
+
+def test_moe_forward_shape_and_aux_loss():
+    block = _block()
+    x = jax.random.normal(jax.random.PRNGKey(0), (2, 16, 32))
+    variables = block.init({"params": jax.random.PRNGKey(1)}, x, is_training=True)
+    out, state = block.apply(
+        {"params": variables["params"]}, x, is_training=True, mutable=["losses"]
+    )
+    assert out.shape == x.shape
+    (aux,) = state["losses"]["moe_aux_loss"]
+    # Balance loss is ≥ 1 (uniform router) and finite.
+    assert np.isfinite(float(aux)) and float(aux) >= 1.0 - 1e-3
+
+
+def test_moe_top1_single_expert_matches_dense_ff():
+    """E=1, k=1, ample capacity: MoE must reduce to the expert MLP exactly."""
+    block = _block(num_experts=1, top_k=1, capacity_factor=2.0)
+    x = jax.random.normal(jax.random.PRNGKey(0), (2, 8, 16))
+    variables = block.init({"params": jax.random.PRNGKey(1)}, x, is_training=False)
+    out = block.apply(variables, x, is_training=False)
+    p = variables["params"]
+    h = jax.nn.gelu(x @ p["experts_w1"][0] + p["experts_b1"][0])
+    ref = h @ p["experts_w2"][0] + p["experts_b2"][0]
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-5, rtol=1e-5)
+
+
+def test_moe_capacity_overflow_drops_tokens():
+    """With capacity 1 token/expert, most tokens fall through to zero output."""
+    block = _block(num_experts=2, top_k=1, capacity_factor=1e-9)
+    x = jax.random.normal(jax.random.PRNGKey(0), (1, 32, 16))
+    variables = block.init({"params": jax.random.PRNGKey(1)}, x, is_training=False)
+    out = np.asarray(block.apply(variables, x, is_training=False))
+    # capacity = max(k, ceil(...)) = 1 → at most 2 tokens (1/expert) non-zero.
+    nonzero_tokens = np.sum(np.any(out[0] != 0.0, axis=-1))
+    assert nonzero_tokens <= 2
+
+
+def test_moe_rejects_bad_top_k():
+    block = _block(num_experts=2, top_k=3)
+    x = jnp.zeros((1, 4, 8))
+    with pytest.raises(ValueError, match="top_k"):
+        block.init({"params": jax.random.PRNGKey(0)}, x, is_training=False)
+
+
+def test_moe_vit_model_forward():
+    model = create_model("vit_moe_s_patch16_e8", num_classes=10, num_layers=2,
+                         embed_dim=64, num_heads=4)
+    x = jnp.ones((2, 32, 32, 3))
+    variables = model.init({"params": jax.random.PRNGKey(0)}, x, is_training=False)
+    logits = model.apply(variables, x, is_training=False)
+    assert logits.shape == (2, 10)
+    # Block 1 (every other) carries expert weights, block 0 does not.
+    enc = variables["params"]["Encoder_0"]
+    assert "MoEFFBlock_0" in enc["block_1"]
+    assert "MoEFFBlock_0" not in enc["block_0"]
+
+
+def test_moe_expert_parallel_sharding(devices):
+    """Expert weights shard over the 'expert' axis; grads stay finite."""
+    mesh = create_mesh({"data": 2, "expert": 4})
+    model = create_model("vit_moe_s_patch16_e8", num_classes=10, num_layers=2,
+                         embed_dim=64, num_heads=4)
+    x = jax.random.normal(jax.random.PRNGKey(0), (4, 32, 32, 3))
+    variables = model.init({"params": jax.random.PRNGKey(1)}, x, is_training=False)
+    params = variables["params"]
+
+    shardings = param_shardings(params, mesh)
+    w1_sh = shardings["Encoder_0"]["block_1"]["MoEFFBlock_0"]["experts_w1"]
+    assert w1_sh.spec[0] == "expert"
+    router_sh = shardings["Encoder_0"]["block_1"]["MoEFFBlock_0"]["router"]
+    assert router_sh.spec == ()
+
+    params = shard_params(params, mesh)
+
+    def loss_fn(params, x):
+        logits, state = model.apply(
+            {"params": params}, x, is_training=True,
+            rngs={"dropout": jax.random.PRNGKey(2),
+                  "stochastic_depth": jax.random.PRNGKey(3)},
+            mutable=["losses"],
+        )
+        aux = sum(jnp.sum(l) for l in jax.tree.leaves(state["losses"]))
+        return jnp.mean(logits**2) + 0.01 * aux
+
+    val, grads = jax.jit(jax.value_and_grad(loss_fn))(params, x)
+    assert np.isfinite(float(jax.device_get(val)))
+    assert all(
+        np.isfinite(np.asarray(jax.device_get(g))).all()
+        for g in jax.tree.leaves(grads)
+    )
+
+
+def test_moe_trainer_step_includes_aux_loss(devices):
+    """Full train step on an expert-parallel mesh: aux loss in metrics."""
+    from sav_tpu.data import synthetic_data_iterator
+    from sav_tpu.train import TrainConfig, Trainer
+
+    axes = {"data": 2, "expert": 4}
+    mesh = create_mesh(axes)
+    config = TrainConfig(
+        model_name="vit_moe_s_patch16_e8",
+        num_classes=10,
+        image_size=32,
+        compute_dtype="float32",
+        global_batch_size=8,
+        num_train_images=32,
+        num_epochs=2,
+        warmup_epochs=1,
+        transpose_images=False,
+        mesh_axes=axes,
+        seed=0,
+    )
+    model = create_model(
+        "vit_moe_s_patch16_e8", num_classes=10, num_layers=2, embed_dim=64,
+        num_heads=4,
+    )
+    trainer = Trainer(config, mesh=mesh, model=model)
+    state = trainer.init_state()
+    batch = next(synthetic_data_iterator(batch_size=8, image_size=32, num_classes=10))
+    state, metrics = trainer.train_step(state, batch, jax.random.PRNGKey(0))
+    assert np.isfinite(float(jax.device_get(metrics["loss"])))
+    aux = float(jax.device_get(metrics["aux_loss"]))
+    assert np.isfinite(aux) and aux >= 0.5

@@ -193,19 +193,37 @@ def _flash_fwd(q, k, v, bias, scale, block_q, block_kv, interpret):
 
 
 def _flash_bwd(scale, block_q, block_kv, interpret, residuals, g):
-    """Flash-style recompute backward in XLA (fp32 softmax math)."""
+    """Flash-style recompute backward in XLA.
+
+    Softmax statistics stay fp32, but every matmul runs with the *input*
+    dtype of q/k/v (bf16 in training) and fp32 MXU accumulation
+    (``preferred_element_type``) — feeding fp32 operands to the MXU would
+    run it at a fraction of peak for no accuracy gain over bf16-in/f32-acc.
+    """
     q, k, v, bias = residuals
     del block_q, block_kv, interpret
+    mm_dtype = q.dtype
     s = jnp.einsum("bqhd,bkhd->bhqk", q, k, preferred_element_type=jnp.float32) * scale
     if bias is not None:
         s = s + bias.astype(jnp.float32)
     p = jax.nn.softmax(s, axis=-1)  # [B, H, Lq, Lk] fp32
-    g32 = g.astype(jnp.float32)
-    dv = jnp.einsum("bhqk,bqhd->bkhd", p, g32, preferred_element_type=jnp.float32)
-    dp = jnp.einsum("bqhd,bkhd->bhqk", g32, v.astype(jnp.float32))
-    ds = p * (dp - jnp.sum(p * dp, axis=-1, keepdims=True))  # [B, H, Lq, Lk]
-    dq = jnp.einsum("bhqk,bkhd->bqhd", ds, k.astype(jnp.float32)) * scale
-    dk = jnp.einsum("bhqk,bqhd->bkhd", ds, q.astype(jnp.float32)) * scale
+    p_mm = p.astype(mm_dtype)
+    g_mm = g.astype(mm_dtype)
+    dv = jnp.einsum("bhqk,bqhd->bkhd", p_mm, g_mm, preferred_element_type=jnp.float32)
+    dp = jnp.einsum(
+        "bqhd,bkhd->bhqk", g_mm, v.astype(mm_dtype),
+        preferred_element_type=jnp.float32,
+    )
+    ds = p * (dp - jnp.sum(p * dp, axis=-1, keepdims=True))  # fp32
+    ds_mm = ds.astype(mm_dtype)
+    dq = jnp.einsum(
+        "bhqk,bkhd->bqhd", ds_mm, k.astype(mm_dtype),
+        preferred_element_type=jnp.float32,
+    ) * scale
+    dk = jnp.einsum(
+        "bhqk,bqhd->bkhd", ds_mm, q.astype(mm_dtype),
+        preferred_element_type=jnp.float32,
+    ) * scale
     if bias is not None:
         dbias = ds
         # Un-broadcast to the original bias shape.

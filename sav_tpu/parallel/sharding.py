@@ -29,6 +29,8 @@ from sav_tpu.parallel.mesh import EXPERT_AXIS, FSDP_AXIS, MODEL_AXIS
 
 # (path regex, partition spec builder taking the param ndim)
 DEFAULT_TP_RULES: list[tuple[str, Any]] = [
+    (r"to_qkv/kernel$", P(None, None, MODEL_AXIS, None)),
+    (r"to_qkv/bias$", P(None, MODEL_AXIS, None)),
     (r"to_q/kernel$", P(None, MODEL_AXIS, None)),
     (r"to_k/kernel$", P(None, MODEL_AXIS, None)),
     (r"to_v/kernel$", P(None, MODEL_AXIS, None)),

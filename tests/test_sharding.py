@@ -58,7 +58,7 @@ def test_tp_param_specs():
     )
     specs = param_path_specs(variables["params"])
     block = specs["Encoder_0"]["block_0"]["SelfAttentionBlock_0"]
-    assert block["to_q"]["kernel"] == P(None, MODEL_AXIS, None)
+    assert block["to_qkv"]["kernel"] == P(None, None, MODEL_AXIS, None)
     assert block["to_out"]["kernel"] == P(MODEL_AXIS, None, None)
     ff = specs["Encoder_0"]["block_0"]["FFBlock_0"]
     assert ff["fc1"]["kernel"] == P(None, MODEL_AXIS)
@@ -92,11 +92,11 @@ def test_tp_state_actually_sharded(devices):
     cfg = _config(mesh_axes={"data": 4, "model": 2})
     trainer = Trainer(cfg, mesh=mesh, model=_model())
     state = trainer.init_state()
-    qkern = state.params["Encoder_0"]["block_0"]["SelfAttentionBlock_0"]["to_q"]["kernel"]
+    qkern = state.params["Encoder_0"]["block_0"]["SelfAttentionBlock_0"]["to_qkv"]["kernel"]
     # heads axis split in 2 → each shard holds half the heads.
-    assert qkern.sharding.spec == P(None, MODEL_AXIS, None)
+    assert qkern.sharding.spec == P(None, None, MODEL_AXIS, None)
     shard_shape = qkern.sharding.shard_shape(qkern.shape)
-    assert shard_shape[1] == qkern.shape[1] // 2
+    assert shard_shape[2] == qkern.shape[2] // 2
     # Optimizer state mirrors pick up the same sharding via path suffixes.
     def has_model_axis(spec):
         return any(

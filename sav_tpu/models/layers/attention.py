@@ -20,6 +20,7 @@ import jax
 import jax.numpy as jnp
 
 from sav_tpu.ops.attention import dot_product_attention
+from sav_tpu.ops.rotary import apply_rotary_pos_emb, fixed_positional_embedding
 
 Dtype = Any
 
@@ -93,6 +94,9 @@ class AttentionBlock(nn.Module):
     # (to_qkv instead of to_q/to_k/to_v) — set False for the reference's
     # three-projection layout if a checkpoint/repro needs it.
     fused_qkv: bool = True
+    # RoPE on Q/K after projection (the working rebuild of the reference's
+    # broken, never-wired rotary path — SURVEY.md §2.9 #12).
+    use_rotary: bool = False
     backend: Optional[str] = None  # None/'auto' | 'xla' | 'pallas'
     dtype: Dtype = jnp.float32
 
@@ -129,6 +133,13 @@ class AttentionBlock(nn.Module):
             query = proj(name="to_q")(inputs_q)
             key = proj(name="to_k")(inputs_kv)
             value = proj(name="to_v")(inputs_kv)
+
+        if self.use_rotary:
+            sincos = fixed_positional_embedding(query.shape[1], head_ch)
+            query = apply_rotary_pos_emb(query, sincos)
+            if key.shape[1] != query.shape[1]:
+                sincos = fixed_positional_embedding(key.shape[1], head_ch)
+            key = apply_rotary_pos_emb(key, sincos)
 
         has_attn_dropout = self.attn_dropout_rate > 0.0 and is_training
         if self.talking_heads:

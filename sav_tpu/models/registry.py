@@ -51,6 +51,9 @@ register("vit_b_patch32", ViT, **_vit(768, 12, 12, 32))
 register("vit_b_patch16", ViT, **_vit(768, 12, 12, 16))
 register("vit_l_patch32", ViT, **_vit(1024, 24, 16, 32))
 register("vit_l_patch16", ViT, **_vit(1024, 24, 16, 16))
+# RoPE variant: the reference declared rotary in its to-do (README.md:5) but
+# never wired it (SURVEY.md §2.9 #12); here it is a working first-class config.
+register("vit_s_patch16_rope", ViT, **_vit(384, 12, 6, 16), pos_embed="rotary")
 # MoE variant (beyond reference parity): DeiT-S trunk with a top-2-routed
 # 8-expert FF on every other block; experts shard over the 'expert' mesh axis.
 register(

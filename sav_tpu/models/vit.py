@@ -16,6 +16,7 @@ import jax.numpy as jnp
 from sav_tpu.models.layers import (
     AddAbsPosEmbed,
     FFBlock,
+    FixedPositionalEmbedding,
     PatchEmbedBlock,
     SelfAttentionBlock,
 )
@@ -33,6 +34,7 @@ class EncoderBlock(nn.Module):
     dropout_rate: float = 0.0
     moe_num_experts: Optional[int] = None  # MoE FF instead of dense FF
     moe_top_k: int = 2
+    use_rotary: bool = False
     backend: Optional[str] = None
     dtype: Dtype = jnp.float32
 
@@ -43,6 +45,7 @@ class EncoderBlock(nn.Module):
             num_heads=self.num_heads,
             attn_dropout_rate=self.attn_dropout_rate,
             out_dropout_rate=self.dropout_rate,
+            use_rotary=self.use_rotary,
             backend=self.backend,
             dtype=self.dtype,
         )(x, is_training)
@@ -76,12 +79,22 @@ class Encoder(nn.Module):
     moe_num_experts: Optional[int] = None
     moe_top_k: int = 2
     moe_every: int = 2  # MoE FF on every moe_every-th block (GShard-style)
+    # 'learned' (reference vit.py:46), 'sincos', 'rotary' (RoPE on Q/K in
+    # every block), or 'none'.
+    pos_embed: str = "learned"
     backend: Optional[str] = None
     dtype: Dtype = jnp.float32
 
     @nn.compact
     def __call__(self, inputs: jax.Array, is_training: bool) -> jax.Array:
-        x = AddAbsPosEmbed(dtype=self.dtype)(inputs)
+        if self.pos_embed == "learned":
+            x = AddAbsPosEmbed(dtype=self.dtype)(inputs)
+        elif self.pos_embed == "sincos":
+            x = FixedPositionalEmbedding(dtype=self.dtype)(inputs)
+        elif self.pos_embed in ("rotary", "none"):
+            x = inputs
+        else:
+            raise ValueError(f"unknown pos_embed mode: {self.pos_embed!r}")
         x = nn.Dropout(rate=self.dropout_rate)(x, deterministic=not is_training)
         for i in range(self.num_layers):
             is_moe = bool(self.moe_num_experts) and i % self.moe_every == (
@@ -94,6 +107,7 @@ class Encoder(nn.Module):
                 dropout_rate=self.dropout_rate,
                 moe_num_experts=self.moe_num_experts if is_moe else None,
                 moe_top_k=self.moe_top_k,
+                use_rotary=self.pos_embed == "rotary",
                 backend=self.backend,
                 dtype=self.dtype,
                 name=f"block_{i}",
@@ -115,6 +129,7 @@ class ViT(nn.Module):
     moe_num_experts: Optional[int] = None
     moe_top_k: int = 2
     moe_every: int = 2
+    pos_embed: str = "learned"
     backend: Optional[str] = None
     dtype: Dtype = jnp.float32
 
@@ -136,6 +151,7 @@ class ViT(nn.Module):
             moe_num_experts=self.moe_num_experts,
             moe_top_k=self.moe_top_k,
             moe_every=self.moe_every,
+            pos_embed=self.pos_embed,
             backend=self.backend,
             dtype=self.dtype,
         )(x, is_training)

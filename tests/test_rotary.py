@@ -1,0 +1,92 @@
+"""Rotary position embeddings: op properties and model wiring."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from sav_tpu.models import create_model
+from sav_tpu.ops.rotary import (
+    apply_rotary_pos_emb,
+    fixed_positional_embedding,
+    rotate_every_two,
+)
+
+
+def test_rotate_every_two():
+    x = jnp.asarray([[1.0, 2.0, 3.0, 4.0]])
+    np.testing.assert_allclose(
+        np.asarray(rotate_every_two(x)), [[-2.0, 1.0, -4.0, 3.0]]
+    )
+
+
+def test_rope_preserves_norm():
+    """Rotation is orthogonal: per-pair vector norms are unchanged."""
+    x = jax.random.normal(jax.random.PRNGKey(0), (2, 16, 4, 32))
+    sincos = fixed_positional_embedding(16, 32)
+    y = apply_rotary_pos_emb(x, sincos)
+    np.testing.assert_allclose(
+        np.linalg.norm(np.asarray(x), axis=-1),
+        np.linalg.norm(np.asarray(y), axis=-1),
+        rtol=1e-5,
+    )
+
+
+def test_rope_relative_property():
+    """q·k after RoPE depends only on the position *offset*: shifting both
+    positions by the same amount leaves the dot product unchanged."""
+    d = 32
+    q = jax.random.normal(jax.random.PRNGKey(0), (d,))
+    k = jax.random.normal(jax.random.PRNGKey(1), (d,))
+    L = 24
+
+    def dot_at(pos_q, pos_k):
+        sincos = fixed_positional_embedding(L, d)
+        qs = jnp.zeros((1, L, d)).at[0, pos_q].set(q)
+        ks = jnp.zeros((1, L, d)).at[0, pos_k].set(k)
+        qr = apply_rotary_pos_emb(qs, sincos)[0, pos_q]
+        kr = apply_rotary_pos_emb(ks, sincos)[0, pos_k]
+        return float(jnp.dot(qr, kr))
+
+    np.testing.assert_allclose(dot_at(3, 7), dot_at(10, 14), rtol=1e-5)
+    np.testing.assert_allclose(dot_at(0, 5), dot_at(12, 17), rtol=1e-5)
+    assert abs(dot_at(3, 7) - dot_at(3, 12)) > 1e-4  # different offsets differ
+
+
+def test_rope_odd_dim_rejected():
+    with pytest.raises(ValueError, match="even"):
+        fixed_positional_embedding(8, 33)
+
+
+@pytest.mark.parametrize("mode", ["learned", "sincos", "rotary", "none"])
+def test_vit_pos_embed_modes(mode):
+    model = create_model(
+        "vit_s_patch16_rope", num_classes=10, num_layers=2, embed_dim=64,
+        num_heads=4, pos_embed=mode,
+    )
+    x = jax.random.normal(jax.random.PRNGKey(0), (2, 32, 32, 3))
+    variables = model.init({"params": jax.random.PRNGKey(1)}, x, is_training=False)
+    logits = model.apply(variables, x, is_training=False)
+    assert logits.shape == (2, 10)
+    has_table = "AddAbsPosEmbed_0" in variables["params"]["Encoder_0"]
+    assert has_table == (mode == "learned")
+
+
+def test_vit_rope_is_position_sensitive():
+    """With RoPE (and no other position source), permuting patches must
+    change pre-head features — attention is no longer permutation-equivariant."""
+    model = create_model(
+        "vit_s_patch16_rope", num_classes=10, num_layers=2, embed_dim=64,
+        num_heads=4,
+    )
+    x = jax.random.normal(jax.random.PRNGKey(0), (1, 32, 32, 3))
+    # Swap the top and bottom halves of the image (patch rows permute).
+    x_perm = jnp.concatenate([x[:, 16:], x[:, :16]], axis=1)
+    variables = model.init({"params": jax.random.PRNGKey(1)}, x, is_training=False)
+    p = variables["params"]
+    p["head"]["kernel"] = jax.random.normal(
+        jax.random.PRNGKey(2), p["head"]["kernel"].shape
+    ) * 0.05
+    out = model.apply({"params": p}, x, is_training=False)
+    out_perm = model.apply({"params": p}, x_perm, is_training=False)
+    assert float(jnp.max(jnp.abs(out - out_perm))) > 1e-4

@@ -11,31 +11,15 @@
 //
 // Build: `make -C native` → native/libsavtpu_loader.so
 
-#include <atomic>
 #include <cstdint>
 #include <cstring>
-#include <thread>
 #include <vector>
+
+#include "parallel_for.h"
 
 namespace {
 
-// Run fn(i) for i in [0, n) over `threads` workers.
-template <typename F>
-void parallel_for(int64_t n, int threads, F fn) {
-  if (threads <= 1 || n < 2) {
-    for (int64_t i = 0; i < n; ++i) fn(i);
-    return;
-  }
-  std::atomic<int64_t> next(0);
-  std::vector<std::thread> pool;
-  pool.reserve(threads);
-  for (int t = 0; t < threads; ++t) {
-    pool.emplace_back([&] {
-      for (int64_t i = next.fetch_add(1); i < n; i = next.fetch_add(1)) fn(i);
-    });
-  }
-  for (auto& th : pool) th.join();
-}
+using sav::parallel_for;
 
 inline uint16_t f32_to_bf16_scalar(float x) {
   uint32_t bits;

@@ -3,6 +3,12 @@ from sav_tpu.data.native_loader import (
     PrefetchLoader,
     native_available,
 )
+from sav_tpu.data.records import (
+    SavRecDataset,
+    host_shard_indices,
+    savrec_epoch_iterator,
+    write_savrec,
+)
 from sav_tpu.data.synthetic import fake_data_iterator, synthetic_data_iterator
 
 __all__ = [
@@ -10,6 +16,10 @@ __all__ = [
     "parse_augment_spec",
     "PrefetchLoader",
     "native_available",
+    "SavRecDataset",
+    "write_savrec",
+    "savrec_epoch_iterator",
+    "host_shard_indices",
     "fake_data_iterator",
     "synthetic_data_iterator",
     "load",

@@ -13,12 +13,14 @@ REPO = Path(__file__).resolve().parent.parent
 @pytest.fixture(scope="module", autouse=True)
 def built_lib():
     lib = REPO / "native" / "libsavtpu_loader.so"
-    if not lib.exists():
-        try:
-            subprocess.run(
-                ["make", "-C", str(REPO / "native")], check=True, capture_output=True
-            )
-        except (subprocess.CalledProcessError, FileNotFoundError) as e:
+    # Always run make — it is incremental, and a stale .so from before a
+    # source change would silently miss new symbols.
+    try:
+        subprocess.run(
+            ["make", "-C", str(REPO / "native")], check=True, capture_output=True
+        )
+    except (subprocess.CalledProcessError, FileNotFoundError) as e:
+        if not lib.exists():
             pytest.skip(f"native build unavailable: {e}")
     return lib
 

@@ -6,10 +6,13 @@ from sav_tpu.models.ceit import CeiT
 from sav_tpu.models.cvt import CvT
 from sav_tpu.models.mlp_mixer import MLPMixer
 from sav_tpu.models.registry import create_model, model_names, register
+from sav_tpu.models.surgery import adapt_pos_embeds, resize_pos_embed_table
 from sav_tpu.models.tnt import TNT
 from sav_tpu.models.vit import ViT
 
 __all__ = [
+    "adapt_pos_embeds",
+    "resize_pos_embed_table",
     "ViT",
     "BoTNet",
     "CeiT",

@@ -1,0 +1,88 @@
+"""Parameter surgery: resolution transfer for pretrained checkpoints.
+
+Capability beyond the reference (which had no finetuning path at all): the
+standard ViT recipe of bicubic-resampling the learned absolute position
+table when changing input resolution (DeiT/CaiT finetune at 384 from a 224
+pretrain this way). Works on any param tree containing ``AddAbsPosEmbed``
+tables (ViT, CaiT, TNT outer stream, MLP-Mixer has none).
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+POS_EMBED_KEY = "pos_embed"
+
+
+def _has_cls(length: int) -> bool:
+    """Infer a leading CLS slot from the token count: k² → pure grid,
+    1 + k² → CLS + grid (the two are never ambiguous for k ≥ 1)."""
+    if math.isqrt(length) ** 2 == length:
+        return False
+    if math.isqrt(length - 1) ** 2 == length - 1:
+        return True
+    raise ValueError(f"token count {length} is neither k² nor 1+k²")
+
+
+def resize_pos_embed_table(
+    table: jax.Array,
+    new_len: int,
+    *,
+    has_cls: bool | None = None,
+    method: str = "bicubic",
+) -> jax.Array:
+    """Resample a ``[1, L, D]`` position table to ``[1, new_len, D]``.
+
+    The (square) patch grid is resized with ``jax.image.resize``; a leading
+    CLS position (auto-detected from the token count unless ``has_cls`` is
+    given) is carried over unchanged.
+    """
+    if table.ndim != 3 or table.shape[0] != 1:
+        raise ValueError(f"expected [1, L, D] table, got {table.shape}")
+    if table.shape[1] == new_len:
+        return table
+    if has_cls is None:
+        has_cls = _has_cls(table.shape[1])
+    cls_part = table[:, :1] if has_cls else table[:, :0]
+    grid_part = table[:, 1:] if has_cls else table
+    grid_new = new_len - cls_part.shape[1]
+    g_old = math.isqrt(grid_part.shape[1])
+    g_new = math.isqrt(grid_new)
+    if g_old * g_old != grid_part.shape[1] or g_new * g_new != grid_new:
+        raise ValueError(
+            f"non-square grids: {grid_part.shape[1]} -> {grid_new} tokens"
+        )
+    dim = table.shape[-1]
+    grid = grid_part.reshape(1, g_old, g_old, dim).astype(jnp.float32)
+    resized = jax.image.resize(grid, (1, g_new, g_new, dim), method=method)
+    resized = resized.reshape(1, grid_new, dim).astype(table.dtype)
+    return jnp.concatenate([cls_part, resized], axis=1)
+
+
+def adapt_pos_embeds(params: Any, target_params: Any, *,
+                     has_cls: bool | None = None,
+                     method: str = "bicubic") -> Any:
+    """Return ``params`` with every ``pos_embed`` table resized to match the
+    corresponding table in ``target_params`` (e.g. from ``model.init`` at
+    the new resolution). All other leaves pass through unchanged; shapes
+    that already match are untouched.
+    """
+    flat_tgt = {
+        tuple(p): l
+        for p, l in jax.tree_util.tree_flatten_with_path(target_params)[0]
+    }
+
+    def fix(path, leaf):
+        key = path[-1].key if hasattr(path[-1], "key") else str(path[-1])
+        tgt = flat_tgt.get(tuple(path))
+        if key == POS_EMBED_KEY and tgt is not None and tgt.shape != leaf.shape:
+            return resize_pos_embed_table(
+                leaf, tgt.shape[1], has_cls=has_cls, method=method
+            )
+        return leaf
+
+    return jax.tree_util.tree_map_with_path(fix, params)

@@ -40,6 +40,7 @@ class TrainConfig:
     clip_grad_norm: Optional[float] = 1.0
     label_smoothing: float = 0.1
     aux_loss_weight: float = 0.01  # weight on sown 'losses' (MoE balance etc.)
+    grad_accum_steps: int = 1  # micro-batches per optimizer update
     seed: int = 42
 
     # Mesh: axis name -> size (-1 absorbs remaining devices)

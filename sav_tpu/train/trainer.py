@@ -145,15 +145,14 @@ class Trainer:
 
     def _train_step_impl(self, state: TrainState, batch: dict, rng: jax.Array):
         step_rng = jax.random.fold_in(rng, state.step)
-        dropout_rng, sd_rng = jax.random.split(step_rng)
         images = self._prep_images(batch["images"])
         label_probs = self._label_probs(batch)
         has_bn = bool(state.batch_stats)
 
-        def loss_fn(params):
+        def loss_fn(params, batch_stats, images, label_probs, dropout_rng, sd_rng):
             variables = {"params": params}
             if has_bn:
-                variables["batch_stats"] = state.batch_stats
+                variables["batch_stats"] = batch_stats
             # 'losses' collects auxiliary objectives modules sow (e.g. the
             # MoE load-balancing loss); empty for most models.
             mutable = ["batch_stats", "losses"] if has_bn else ["losses"]
@@ -164,9 +163,7 @@ class Trainer:
                 rngs={"dropout": dropout_rng, "stochastic_depth": sd_rng},
                 mutable=mutable,
             )
-            new_batch_stats = (
-                new_vars["batch_stats"] if has_bn else state.batch_stats
-            )
+            new_batch_stats = new_vars["batch_stats"] if has_bn else batch_stats
             aux = sum(
                 jnp.sum(leaf)
                 for leaf in jax.tree.leaves(new_vars.get("losses", {}))
@@ -178,9 +175,52 @@ class Trainer:
             )
             return loss, (logits, new_batch_stats, aux)
 
-        (loss, (logits, new_batch_stats, aux_loss)), grads = jax.value_and_grad(
-            loss_fn, has_aux=True
-        )(state.params)
+        grad_fn = jax.value_and_grad(loss_fn, has_aux=True)
+        accum = self.config.grad_accum_steps
+        if accum < 1:
+            raise ValueError(f"grad_accum_steps must be >= 1, got {accum}")
+        if accum == 1:
+            dropout_rng, sd_rng = jax.random.split(step_rng)
+            (loss, (logits, new_batch_stats, aux_loss)), grads = grad_fn(
+                state.params, state.batch_stats, images, label_probs,
+                dropout_rng, sd_rng,
+            )
+        else:
+            # Gradient accumulation: scan over micro-batches, averaging
+            # grads/losses; one optimizer update. BatchNorm statistics
+            # thread through the scan carry (each micro-batch sees the
+            # previous micro-batch's running stats, like sequential steps).
+            b = images.shape[0]
+            if b % accum:
+                raise ValueError(
+                    f"batch size {b} not divisible by grad_accum_steps {accum}"
+                )
+
+            def split(x):
+                return x.reshape(accum, b // accum, *x.shape[1:])
+
+            def micro(carry, xs):
+                bs, gsum, lsum, asum, i = carry
+                im, lp = xs
+                dr, sr = jax.random.split(jax.random.fold_in(step_rng, i))
+                (l, (lg, nbs, ax)), g = grad_fn(
+                    state.params, bs, im, lp, dr, sr
+                )
+                gsum = jax.tree.map(jnp.add, gsum, g)
+                return (nbs, gsum, lsum + l, asum + ax, i + 1), lg
+
+            zeros = jax.tree.map(jnp.zeros_like, state.params)
+            carry0 = (
+                state.batch_stats, zeros, jnp.float32(0.0), jnp.float32(0.0),
+                jnp.int32(0),
+            )
+            (new_batch_stats, gsum, lsum, asum, _), logits_stack = jax.lax.scan(
+                micro, carry0, (split(images), split(label_probs))
+            )
+            grads = jax.tree.map(lambda g: g / accum, gsum)
+            loss = lsum / accum
+            aux_loss = asum / accum
+            logits = logits_stack.reshape(b, *logits_stack.shape[2:])
         updates, new_opt_state = self.tx.update(grads, state.opt_state, state.params)
         new_params = optax.apply_updates(state.params, updates)
         new_state = state.replace(

@@ -1,0 +1,66 @@
+"""Position-embedding resolution transfer."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from sav_tpu.models import create_model
+from sav_tpu.models.surgery import adapt_pos_embeds, resize_pos_embed_table
+
+
+def test_resize_identity():
+    t = jax.random.normal(jax.random.PRNGKey(0), (1, 197, 16))
+    assert resize_pos_embed_table(t, 197) is t
+
+
+def test_resize_cls_preserved():
+    t = jax.random.normal(jax.random.PRNGKey(0), (1, 1 + 14 * 14, 8))
+    out = resize_pos_embed_table(t, 1 + 24 * 24)
+    assert out.shape == (1, 1 + 24 * 24, 8)
+    np.testing.assert_array_equal(np.asarray(out[:, 0]), np.asarray(t[:, 0]))
+
+
+def test_resize_no_cls():
+    t = jax.random.normal(jax.random.PRNGKey(0), (1, 49, 8))
+    out = resize_pos_embed_table(t, 196)
+    assert out.shape == (1, 196, 8)
+
+
+def test_resize_roundtrip_close():
+    """Up then down returns near the original (low-frequency tables)."""
+    g = jnp.linspace(0, 1, 14)
+    smooth = (g[:, None] + g[None, :]).reshape(1, 196, 1)
+    smooth = jnp.broadcast_to(smooth, (1, 196, 4))
+    up = resize_pos_embed_table(smooth, 576)
+    back = resize_pos_embed_table(up, 196)
+    np.testing.assert_allclose(np.asarray(back), np.asarray(smooth), atol=5e-2)
+
+
+def test_resize_rejects_non_square():
+    t = jnp.zeros((1, 12, 8))
+    with pytest.raises(ValueError, match="neither"):
+        resize_pos_embed_table(t, 16)
+
+
+def test_vit_finetune_at_higher_resolution():
+    """224-pretrained ViT params transfer to 384 input and run."""
+    model = create_model("vit_s_patch16", num_classes=10, num_layers=2,
+                         embed_dim=64, num_heads=4)
+    x224 = jnp.ones((1, 224, 224, 3))
+    x384 = jnp.ones((1, 384, 384, 3))
+    p224 = model.init({"params": jax.random.PRNGKey(0)}, x224,
+                      is_training=False)["params"]
+    p384_tpl = jax.eval_shape(
+        lambda: model.init({"params": jax.random.PRNGKey(0)}, x384,
+                           is_training=False)["params"]
+    )
+    p384 = adapt_pos_embeds(p224, p384_tpl)
+    table = p384["Encoder_0"]["AddAbsPosEmbed_0"]["pos_embed"]
+    assert table.shape == (1, 1 + 24 * 24, 64)
+    logits = model.apply({"params": p384}, x384, is_training=False)
+    assert logits.shape == (1, 10)
+    # Non-pos-embed leaves are untouched.
+    np.testing.assert_array_equal(
+        np.asarray(p384["head"]["kernel"]), np.asarray(p224["head"]["kernel"])
+    )

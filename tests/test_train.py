@@ -210,3 +210,59 @@ def test_schedule_shape():
     assert float(sched(0)) == 0.0
     assert abs(float(sched(20)) - 1e-3) < 1e-9  # peak at end of warmup
     assert float(sched(100)) <= 1e-4  # decayed
+
+
+def test_grad_accum_matches_full_batch(devices):
+    """K micro-batches, averaged grads → same update as one full batch
+    (deterministic model: no dropout/BN, rates are 0 by default)."""
+    import dataclasses
+
+    from sav_tpu.data import synthetic_data_iterator
+    from sav_tpu.models import create_model
+    from sav_tpu.train import TrainConfig, Trainer
+
+    base = TrainConfig(
+        model_name="vit_ti_patch16", num_classes=10, image_size=16,
+        compute_dtype="float32", global_batch_size=16, num_train_images=64,
+        num_epochs=2, warmup_epochs=1, transpose_images=False,
+        label_smoothing=0.0, base_lr=0.01, seed=0,
+    )
+    model = create_model("vit_ti_patch16", num_classes=10, num_layers=2,
+                         embed_dim=32, num_heads=2, patch_shape=(4, 4))
+    batch = next(synthetic_data_iterator(batch_size=16, image_size=16,
+                                         num_classes=10, seed=5))
+    rng = jax.random.PRNGKey(0)
+    results = {}
+    for accum in (1, 4):
+        cfg = dataclasses.replace(base, grad_accum_steps=accum)
+        trainer = Trainer(cfg, model=model)
+        state = trainer.init_state()
+        state, metrics = trainer.train_step(state, batch, rng)
+        results[accum] = (
+            jax.device_get(state.params["head"]["kernel"]),
+            float(jax.device_get(metrics["loss"])),
+        )
+    np.testing.assert_allclose(results[1][1], results[4][1], rtol=1e-5)
+    np.testing.assert_allclose(results[1][0], results[4][0], rtol=1e-4, atol=1e-6)
+
+
+def test_grad_accum_rejects_indivisible(devices):
+    import dataclasses
+
+    from sav_tpu.data import synthetic_data_iterator
+    from sav_tpu.models import create_model
+    from sav_tpu.train import TrainConfig, Trainer
+
+    cfg = TrainConfig(
+        model_name="vit_ti_patch16", num_classes=10, image_size=16,
+        compute_dtype="float32", global_batch_size=16, num_train_images=64,
+        num_epochs=2, warmup_epochs=1, transpose_images=False,
+        grad_accum_steps=3, seed=0,
+    )
+    model = create_model("vit_ti_patch16", num_classes=10, num_layers=1,
+                         embed_dim=32, num_heads=2, patch_shape=(4, 4))
+    trainer = Trainer(cfg, model=model)
+    state = trainer.init_state()
+    batch = next(synthetic_data_iterator(batch_size=16, image_size=16, num_classes=10))
+    with pytest.raises(ValueError, match="not divisible"):
+        trainer.train_step(state, batch, jax.random.PRNGKey(0))

@@ -31,6 +31,8 @@ import click
 @click.option("--weight-decay", type=float, default=0.05)
 @click.option("--label-smoothing", type=float, default=0.1)
 @click.option("--clip-grad", type=float, default=1.0)
+@click.option("--grad-accum", type=int, default=1,
+              help="Micro-batches per optimizer update.")
 @click.option(
     "-a", "--augmentation", default="cutmix_mixup_randaugment_405",
     help="Augment-string DSL (SURVEY.md §2.4).",
@@ -50,7 +52,8 @@ import click
 def main(
     ctx, data_dir, fake_data, model_name, num_classes, image_size, batch_size,
     num_epochs, learning_rate, weight_decay, label_smoothing, clip_grad,
-    augmentation, backend, dtype, tp, fsdp, preset, checkpoint_dir, steps, seed,
+    grad_accum, augmentation, backend, dtype, tp, fsdp, preset, checkpoint_dir,
+    steps, seed,
 ):
     import jax
 
@@ -81,6 +84,7 @@ def main(
         weight_decay=weight_decay,
         label_smoothing=label_smoothing,
         clip_grad_norm=clip_grad,
+        grad_accum_steps=grad_accum,
         mesh_axes=mesh_axes,
         checkpoint_dir=checkpoint_dir,
         seed=seed,
@@ -99,8 +103,8 @@ def main(
             "batch_size": "global_batch_size", "augmentation": "augment",
             "num_epochs": "num_epochs", "learning_rate": "base_lr",
             "weight_decay": "weight_decay", "label_smoothing": "label_smoothing",
-            "clip_grad": "clip_grad_norm", "checkpoint_dir": "checkpoint_dir",
-            "seed": "seed",
+            "clip_grad": "clip_grad_norm", "grad_accum": "grad_accum_steps",
+            "checkpoint_dir": "checkpoint_dir", "seed": "seed",
         }
         overrides = {
             field: getattr(config, field)

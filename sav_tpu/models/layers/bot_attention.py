@@ -19,6 +19,7 @@ import jax
 import jax.numpy as jnp
 
 from sav_tpu.ops.attention import dot_product_attention
+from sav_tpu.ops.flash_attention import flash_botnet_attention
 from sav_tpu.ops.relative import relative_logits_2d
 
 Dtype = Any
@@ -64,17 +65,36 @@ class BoTMHSA(nn.Module):
             "rel_emb_w", nn.initializers.normal(stddev=stddev), (2 * width - 1, head_ch)
         )
 
-        # Relative logits use the same scaled query as the content logits.
-        q_grid = jnp.transpose(
-            query.reshape(b, height, width, self.num_heads, head_ch), (0, 3, 1, 2, 4)
+        backend = self.backend or "auto"
+        if backend not in ("auto", "xla", "pallas"):
+            raise ValueError(f"unknown attention backend: {backend!r}")
+        # Fused kernel wins once the [B, heads, L, L] bias is big enough to
+        # be HBM-bound (measured crossover ~L=256 on v5e); below that XLA's
+        # fusion of the materialized-bias path is at parity or better.
+        use_fused = backend == "pallas" or (
+            backend == "auto"
+            and jax.default_backend() == "tpu"
+            and height * width >= 256
         )
-        q_grid = q_grid * jnp.asarray(scale, q_grid.dtype)
-        bias = relative_logits_2d(
-            q_grid, rel_k_h.astype(q_grid.dtype), rel_k_w.astype(q_grid.dtype)
-        )
-        bias = bias.reshape(b, self.num_heads, height * width, height * width)
-
-        out = dot_product_attention(
-            query, key, value, bias=bias, scale=scale, backend=self.backend
-        )
+        if use_fused:
+            # Fully fused path: compact per-axis relative logits expand
+            # inside the flash kernel — the [B, heads, L, L] bias never
+            # exists in HBM (SURVEY.md §7 'hard parts').
+            out = flash_botnet_attention(
+                query, key, value, rel_k_h, rel_k_w, height, width, scale=scale
+            )
+        else:
+            # Relative logits use the same scaled query as the content logits.
+            q_grid = jnp.transpose(
+                query.reshape(b, height, width, self.num_heads, head_ch),
+                (0, 3, 1, 2, 4),
+            )
+            q_grid = q_grid * jnp.asarray(scale, q_grid.dtype)
+            bias = relative_logits_2d(
+                q_grid, rel_k_h.astype(q_grid.dtype), rel_k_w.astype(q_grid.dtype)
+            )
+            bias = bias.reshape(b, self.num_heads, height * width, height * width)
+            out = dot_product_attention(
+                query, key, value, bias=bias, scale=scale, backend="xla"
+            )
         return out.reshape(b, height, width, inner)

@@ -39,6 +39,31 @@ def _round_up(x: int, m: int) -> int:
     return ((x + m - 1) // m) * m
 
 
+def _online_softmax_step(s, v_ref, o_ref, m_scr, l_scr, acc_scr, ki,
+                         num_kv_blocks):
+    """Shared flash epilogue: fold this block's logits ``s`` into the running
+    (max, sum, acc) statistics; write the normalized output on the last
+    kv block."""
+    m_prev = m_scr[:, 0:1]
+    l_prev = l_scr[:, 0:1]
+    m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
+    alpha = jnp.exp(m_prev - m_new)
+    p = jnp.exp(s - m_new)
+    l_new = alpha * l_prev + jnp.sum(p, axis=-1, keepdims=True)
+    m_scr[...] = jnp.broadcast_to(m_new, m_scr.shape)
+    l_scr[...] = jnp.broadcast_to(l_new, l_scr.shape)
+    v = v_ref[0]
+    pv = jax.lax.dot_general(
+        p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+    acc_scr[...] = acc_scr[...] * alpha + pv
+
+    @pl.when(ki == num_kv_blocks - 1)
+    def _finalize():
+        o_ref[0] = (acc_scr[...] / l_scr[:, 0:1]).astype(o_ref.dtype)
+
+
 def _kernel(
     q_ref,
     k_ref,
@@ -73,23 +98,8 @@ def _kernel(
         col = ki * block_kv + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
         s = jnp.where(col < kv_len, s, _NEG_INF)
 
-    m_prev = m_scr[:, 0:1]
-    l_prev = l_scr[:, 0:1]
-    m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
-    alpha = jnp.exp(m_prev - m_new)
-    p = jnp.exp(s - m_new)
-    l_new = alpha * l_prev + jnp.sum(p, axis=-1, keepdims=True)
-    m_scr[...] = jnp.broadcast_to(m_new, m_scr.shape)
-    l_scr[...] = jnp.broadcast_to(l_new, l_scr.shape)
-    v = v_ref[0]
-    pv = jax.lax.dot_general(
-        p.astype(v.dtype), v, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
-    )
-    acc_scr[...] = acc_scr[...] * alpha + pv
-
-    @pl.when(ki == num_kv_blocks - 1)
-    def _finalize():
-        o_ref[0] = (acc_scr[...] / l_scr[:, 0:1]).astype(o_ref.dtype)
+    _online_softmax_step(s, v_ref, o_ref, m_scr, l_scr, acc_scr, ki,
+                         num_kv_blocks)
 
 
 def _flash_forward(
@@ -271,3 +281,271 @@ def flash_attention(
     if bias is not None and bias.ndim != 4:
         raise ValueError(f"bias must be 4-D broadcastable, got {bias.shape}")
     return _flash(query, key, value, bias, float(scale), block_q, block_kv, interpret)
+
+# ---------------------------------------------------------------------------
+# BoTNet 2-D relative-position flash attention (SURVEY.md §7 "hard parts"):
+# the rel_h + rel_w logits are folded into the flash inner loop instead of
+# materializing the [B, heads, L, L] bias in HBM. The learned tables enter
+# as *compact* per-axis logits [B, heads, L, 2W-1] (a small XLA einsum);
+# the kernel expands them to the block's [block_q, block_kv] bias with iota
+# index arithmetic and 2W-1 + 2H-1 unrolled masked adds — no gathers.
+# ---------------------------------------------------------------------------
+
+
+def _rel_kernel(
+    q_ref,
+    k_ref,
+    v_ref,
+    rw_ref,
+    rh_ref,
+    o_ref,
+    m_scr,
+    l_scr,
+    acc_scr,
+    *,
+    scale: float,
+    kv_len: int,
+    block_kv: int,
+    num_kv_blocks: int,
+    width: int,
+):
+    ki = pl.program_id(2)
+
+    @pl.when(ki == 0)
+    def _init():
+        m_scr[...] = jnp.full_like(m_scr, _NEG_INF)
+        l_scr[...] = jnp.zeros_like(l_scr)
+        acc_scr[...] = jnp.zeros_like(acc_scr)
+
+    q = q_ref[0]
+    k = k_ref[0]
+    s = jax.lax.dot_general(
+        q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+    )
+    s = s * scale
+
+    # Expand the absolute per-axis logits to this block's bias with two
+    # small MXU matmuls against iota-built selection matrices:
+    #   bias[q, k] = rw_abs[q, kw(k)] + rh_abs[q, kh(k)]
+    #   S_w[r, k] = (kw(k) == r)  →  bias_w = rw_abs_blk @ S_w.
+    # Padded rows of rw/rh are zero and padded selection rows never match,
+    # so padding contributes nothing; padded kv columns are masked below.
+    rw = rw_ref[0]  # [block_q, pad(W)] f32
+    rh = rh_ref[0]  # [block_q, pad(H)] f32
+
+    def selection(rows, key_coord):
+        col = ki * block_kv + jax.lax.broadcasted_iota(
+            jnp.int32, (rows, block_kv), 1
+        )
+        row = jax.lax.broadcasted_iota(jnp.int32, (rows, block_kv), 0)
+        return (key_coord(col) == row).astype(jnp.float32)
+
+    sel_w = selection(rw.shape[1], lambda c: c % width)
+    sel_h = selection(rh.shape[1], lambda c: c // width)
+    bias = jax.lax.dot_general(
+        rw, sel_w, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
+    )
+    bias = bias + jax.lax.dot_general(
+        rh, sel_h, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
+    )
+    s = s + bias
+
+    if num_kv_blocks * block_kv != kv_len:
+        kcol = ki * block_kv + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+        s = jnp.where(kcol < kv_len, s, _NEG_INF)
+
+    _online_softmax_step(s, v_ref, o_ref, m_scr, l_scr, acc_scr, ki,
+                         num_kv_blocks)
+
+
+def _rel_forward(q, k, v, rw_abs, rh_abs, height, width, scale, block_q,
+                 block_kv, interpret):
+    """q/k/v ``[B, L, H, D]``; rw_abs/rh_abs ``[B, heads, L, W / H]`` f32
+    absolute per-axis relative-position logits."""
+    batch, q_len, heads, dim = q.shape
+    kv_len = k.shape[1]
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+
+    def to_bhld(x):
+        b, l, h, d = x.shape
+        return jnp.transpose(x, (0, 2, 1, 3)).reshape(b * h, l, d)
+
+    qf, kf, vf = to_bhld(q), to_bhld(k), to_bhld(v)
+    dim_p = _round_up(dim, 128)
+    block_q = min(block_q, _round_up(q_len, 16))
+    block_kv = min(block_kv, _round_up(kv_len, 16))
+    q_len_p = _round_up(q_len, block_q)
+    kv_len_p = _round_up(kv_len, block_kv)
+
+    def pad3(x, lp):
+        return jnp.pad(x, ((0, 0), (0, lp - x.shape[1]), (0, dim_p - x.shape[2])))
+
+    qf, kf, vf = pad3(qf, q_len_p), pad3(kf, kv_len_p), pad3(vf, kv_len_p)
+
+    def prep_compact(c):
+        bb, hh, ll, rr = c.shape
+        cf = c.reshape(bb * hh, ll, rr).astype(jnp.float32)
+        return jnp.pad(
+            cf, ((0, 0), (0, q_len_p - ll), (0, _round_up(rr, 128) - rr))
+        )
+
+    rwf, rhf = prep_compact(rw_abs), prep_compact(rh_abs)
+
+    num_q_blocks = q_len_p // block_q
+    num_kv_blocks = kv_len_p // block_kv
+    grid = (batch * heads, num_q_blocks, num_kv_blocks)
+    kernel = functools.partial(
+        _rel_kernel,
+        scale=scale,
+        kv_len=kv_len,
+        block_kv=block_kv,
+        num_kv_blocks=num_kv_blocks,
+        width=width,
+    )
+    out = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, block_q, dim_p), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, block_kv, dim_p), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((1, block_kv, dim_p), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec(
+                (1, block_q, rwf.shape[-1]), lambda b, i, j: (b, i, 0)
+            ),
+            pl.BlockSpec(
+                (1, block_q, rhf.shape[-1]), lambda b, i, j: (b, i, 0)
+            ),
+        ],
+        out_specs=pl.BlockSpec((1, block_q, dim_p), lambda b, i, j: (b, i, 0)),
+        out_shape=jax.ShapeDtypeStruct((batch * heads, q_len_p, dim_p), q.dtype),
+        scratch_shapes=[
+            pltpu.VMEM((block_q, 128), jnp.float32),
+            pltpu.VMEM((block_q, 128), jnp.float32),
+            pltpu.VMEM((block_q, dim_p), jnp.float32),
+        ],
+        interpret=interpret,
+    )(qf, kf, vf, rwf, rhf)
+    out = out[:, :q_len, :dim].reshape(batch, heads, q_len, dim)
+    return jnp.transpose(out, (0, 2, 1, 3))
+
+
+def compact_to_absolute(cw: jax.Array, ch: jax.Array, height: int,
+                        width: int) -> tuple[jax.Array, jax.Array]:
+    """Relative-indexed per-axis logits → absolute-indexed.
+
+    ``cw``: ``[B, heads, L, 2W-1]`` (``cw[..., q, r] = q_vec · rel_w[r]``) →
+    ``rw_abs [B, heads, L, W]`` with ``rw_abs[..., q, kw] = cw[..., q,
+    kw - qw + W - 1]`` — the pad-reshape-slice ``rel_to_abs`` trick, applied
+    once in XLA so the kernel only does matmul expansion. Same for ``ch``
+    along the height axis.
+    """
+    from sav_tpu.ops.relative import rel_to_abs
+
+    b, h, l, _ = cw.shape
+    rw = rel_to_abs(cw.reshape(b, h, height, width, 2 * width - 1))
+    rw_abs = rw.reshape(b, h, l, width)
+    ch_t = jnp.swapaxes(ch.reshape(b, h, height, width, 2 * height - 1), 2, 3)
+    rh = rel_to_abs(ch_t)  # [b, h, W, H, H] = [b, n, y, x, X]
+    rh_abs = jnp.transpose(rh, (0, 1, 3, 2, 4)).reshape(b, h, l, height)
+    return rw_abs, rh_abs
+
+
+def expand_relative_bias(rw_abs: jax.Array, rh_abs: jax.Array, height: int,
+                         width: int) -> jax.Array:
+    """Absolute per-axis logits → full ``[B, heads, L, L]`` bias.
+
+    ``bias[q, kh·W + kw] = rh_abs[q, kh] + rw_abs[q, kw]`` — a broadcast
+    sum, so its autodiff transpose is the reduction the backward needs.
+    """
+    b, h, l, _ = rw_abs.shape
+    bias = rh_abs[..., :, None] + rw_abs[..., None, :]  # [b, h, L, H, W]
+    return bias.reshape(b, h, l, l)
+
+
+def _dense_rel_reference(q, k, v, rw_abs, rh_abs, height, width, scale):
+    """Dense attention with expanded relative bias (backward recompute)."""
+    mm = q.dtype
+    s = jnp.einsum(
+        "bqhd,bkhd->bhqk", q, k, preferred_element_type=jnp.float32
+    ) * scale
+    s = s + expand_relative_bias(rw_abs, rh_abs, height, width)
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum(
+        "bhqk,bkhd->bqhd", p.astype(mm), v, preferred_element_type=jnp.float32
+    ).astype(q.dtype)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7, 8, 9, 10))
+def _flash_rel(q, k, v, rw_abs, rh_abs, height, width, scale, block_q,
+               block_kv, interpret):
+    return _rel_forward(
+        q, k, v, rw_abs, rh_abs, height, width, scale, block_q, block_kv,
+        interpret,
+    )
+
+
+def _flash_rel_fwd(q, k, v, rw_abs, rh_abs, height, width, scale, block_q,
+                   block_kv, interpret):
+    out = _rel_forward(
+        q, k, v, rw_abs, rh_abs, height, width, scale, block_q, block_kv,
+        interpret,
+    )
+    return out, (q, k, v, rw_abs, rh_abs)
+
+
+def _flash_rel_bwd(height, width, scale, block_q, block_kv, interpret,
+                   residuals, g):
+    q, k, v, rw_abs, rh_abs = residuals
+    _, vjp = jax.vjp(
+        lambda q, k, v, rw, rh: _dense_rel_reference(
+            q, k, v, rw, rh, height, width, scale
+        ),
+        q, k, v, rw_abs, rh_abs,
+    )
+    return vjp(g)
+
+
+_flash_rel.defvjp(_flash_rel_fwd, _flash_rel_bwd)
+
+
+def flash_botnet_attention(
+    query: jax.Array,
+    key: jax.Array,
+    value: jax.Array,
+    rel_k_h: jax.Array,
+    rel_k_w: jax.Array,
+    height: int,
+    width: int,
+    *,
+    scale: Optional[float] = None,
+    block_q: int = 256,
+    block_kv: int = 256,
+    interpret: Optional[bool] = None,
+) -> jax.Array:
+    """Fused BoTNet attention: 2-D relative logits inside the flash kernel.
+
+    Args:
+      query/key/value: ``[B, L, heads, D]`` with ``L == height * width``.
+      rel_k_h: learned ``[2·height−1, D]`` height-relative table.
+      rel_k_w: learned ``[2·width−1, D]`` width-relative table.
+      scale: content-logit scale, default ``D ** -0.5``; the relative logits
+        use the same scaled query (botnet.py:187-192 semantics).
+
+    Returns:
+      ``[B, L, heads, D]`` in the query dtype. Differentiable w.r.t. all
+      five tensor inputs (backward = flash-style XLA recompute).
+    """
+    b, l, heads, d = query.shape
+    if l != height * width:
+        raise ValueError(f"L={l} != height*width={height * width}")
+    if scale is None:
+        scale = d ** -0.5
+    qs = (query * jnp.asarray(scale, query.dtype)).astype(jnp.float32)
+    cw = jnp.einsum("blhd,rd->bhlr", qs, rel_k_w.astype(jnp.float32))
+    ch = jnp.einsum("blhd,rd->bhlr", qs, rel_k_h.astype(jnp.float32))
+    rw_abs, rh_abs = compact_to_absolute(cw, ch, height, width)
+    return _flash_rel(
+        query, key, value, rw_abs, rh_abs, height, width, float(scale),
+        block_q, block_kv, interpret,
+    )

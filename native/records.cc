@@ -83,7 +83,11 @@ void* sav_rec_open(const char* path) {
   std::memcpy(&f->channels, base + 0x20, 4);
   std::memcpy(&f->label_bytes, base + 0x24, 4);
   // Overflow-safe truncation check: divide, never multiply a corrupt count.
-  if (f->num_records > (len - 0x28) / sizeof(uint64_t) - 1) {
+  // `avail` is how many u64 slots fit after the header; the offsets table
+  // needs num_records + 1 of them, so a header-only file (avail == 0) must
+  // fail before the subtraction, not wrap it around.
+  const size_t avail = (len - 0x28) / sizeof(uint64_t);
+  if (avail == 0 || f->num_records > avail - 1) {
     delete f;
     return fail();
   }

@@ -2,14 +2,24 @@
 
 Capability parity with the reference's mix family
 (/root/reference/input_pipeline.py:248-350): CutMix rectangles with
-area-ratio labels, MixUp with Beta-sampled ratios, and the combined
-mixup-or-cutmix batch policy. Implementation differs deliberately: instead
-of splitting the batch in halves (reference ``my_cutmix``:285-299), each
-example mixes with its ``roll``-by-1 partner — every sample stays in the
-batch, which keeps the effective batch size and is the timm-standard
-formulation. Emits ``labels``, ``mix_labels`` and per-example ``ratio``;
-the trainer mixes one-hot targets accordingly
-(/root/reference/train.py:84-87 behavior).
+area-ratio labels, MixUp with per-example Beta-sampled ratios, and the
+combined policy that applies MixUp to half the batch and CutMix to the
+other half (reference ``my_mixup_cutmix``:328-350). Implementation differs
+deliberately in one way: instead of consuming a 2× batch and mixing its
+halves (``my_cutmix``:285-299), each example mixes with its ``roll``-by-1
+partner — every sample stays in the batch, which keeps the effective batch
+size / epoch accounting and is the timm-standard formulation. Ratios are
+per-example exactly as the reference attaches them per-example
+(:169-182), so the regularization statistics match.
+
+Mixing operates on 0..255 float images *before* normalization (masking and
+convex combinations commute with the per-channel affine normalize, so this
+is numerically identical to the reference's normalize-then-mix order) —
+which is what lets the ``augment_before_mix=False`` path re-augment the
+mixed images as uint8 afterwards (input_pipeline.py:218-222).
+
+Emits ``labels``, ``mix_labels`` and per-example ``ratio``; the trainer
+mixes one-hot targets accordingly (/root/reference/train.py:84-87 behavior).
 """
 
 from __future__ import annotations
@@ -25,71 +35,92 @@ def _sample_beta(shape, alpha: float) -> tf.Tensor:
 
 
 def mixup(batch: dict, alpha: float = 0.2) -> dict:
-    """images ← r·x + (1-r)·roll(x); ratio r ~ Beta(alpha, alpha) per batch."""
+    """images ← r·x + (1-r)·roll(x); ratio r ~ Beta(alpha, alpha) per example
+    (reference attaches ``mixup_ratio`` per example, input_pipeline.py:169-178)."""
     images = tf.cast(batch["images"], tf.float32)
     n = tf.shape(images)[0]
-    ratio = _sample_beta([], alpha)
-    mixed = ratio * images + (1.0 - ratio) * tf.roll(images, 1, axis=0)
+    ratio = _sample_beta([n], alpha)
+    mixed = ratio[:, None, None, None] * images + (
+        1.0 - ratio[:, None, None, None]
+    ) * tf.roll(images, 1, axis=0)
     return dict(
         batch,
         images=mixed,
         mix_labels=tf.roll(batch["labels"], 1, axis=0),
-        ratio=tf.fill([n], tf.cast(ratio, tf.float32)),
+        ratio=ratio,
     )
 
 
-def _cutmix_box(height: int, width: int, alpha: float):
-    """Random box whose area fraction ≈ (1-λ), λ ~ Beta(alpha, alpha)."""
-    lam = _sample_beta([], alpha)
+def _cutmix_mask(n, height, width):
+    """Per-example binary keep-mask ``[n, h, w, 1]`` and kept-area ratio
+    ``[n]``. Box area fraction ≈ (1 − λ) with λ ~ Beta(1, 1) = U(0, 1), the
+    reference's ``cutmix_padding`` distribution (input_pipeline.py:248-282)."""
+    lam = tf.random.uniform([n])
     cut = tf.sqrt(1.0 - lam)
-    cut_h = tf.cast(cut * tf.cast(height, tf.float32), tf.int32)
-    cut_w = tf.cast(cut * tf.cast(width, tf.float32), tf.int32)
-    cy = tf.random.uniform([], 0, height, tf.int32)
-    cx = tf.random.uniform([], 0, width, tf.int32)
-    y0 = tf.clip_by_value(cy - cut_h // 2, 0, height)
-    y1 = tf.clip_by_value(cy + cut_h // 2, 0, height)
-    x0 = tf.clip_by_value(cx - cut_w // 2, 0, width)
-    x1 = tf.clip_by_value(cx + cut_w // 2, 0, width)
-    return y0, y1, x0, x1
+    hf = tf.cast(height, tf.float32)
+    wf = tf.cast(width, tf.float32)
+    cut_h = tf.cast(cut * hf, tf.int32)
+    cut_w = tf.cast(cut * wf, tf.int32)
+    cy = tf.random.uniform([n], 0, height, tf.int32)
+    cx = tf.random.uniform([n], 0, width, tf.int32)
+    y0 = tf.clip_by_value(cy - cut_h // 2, 0, height)[:, None, None, None]
+    y1 = tf.clip_by_value(cy + cut_h // 2, 0, height)[:, None, None, None]
+    x0 = tf.clip_by_value(cx - cut_w // 2, 0, width)[:, None, None, None]
+    x1 = tf.clip_by_value(cx + cut_w // 2, 0, width)[:, None, None, None]
+    rows = tf.range(height)[None, :, None, None]
+    cols = tf.range(width)[None, None, :, None]
+    inside = (rows >= y0) & (rows < y1) & (cols >= x0) & (cols < x1)
+    keep = 1.0 - tf.cast(inside, tf.float32)
+    ratio = tf.reduce_mean(keep, axis=[1, 2, 3])
+    return keep, ratio
 
 
 def cutmix(batch: dict, alpha: float = 1.0) -> dict:
-    """Paste a random box from the rolled partner; label ratio = kept area."""
+    """Paste a random box from the rolled partner; label ratio = kept area.
+
+    Boxes and ratios are per-example (the reference computes one mask per
+    example, input_pipeline.py:166-168). ``alpha`` is accepted for the
+    augment-DSL surface but the box distribution is Beta(1,1) like the
+    reference's ``cutmix_padding``.
+    """
+    del alpha  # reference uses Beta(1, 1) == uniform regardless
     images = tf.cast(batch["images"], tf.float32)
     shape = tf.shape(images)
     n, h, w = shape[0], shape[1], shape[2]
-    y0, y1, x0, x1 = _cutmix_box(h, w, alpha)
-    rows = tf.range(h)[None, :, None, None]
-    cols = tf.range(w)[None, None, :, None]
-    inside = (rows >= y0) & (rows < y1) & (cols >= x0) & (cols < x1)
-    mixed = tf.where(inside, tf.roll(images, 1, axis=0), images)
-    box_area = tf.cast((y1 - y0) * (x1 - x0), tf.float32)
-    ratio = 1.0 - box_area / tf.cast(h * w, tf.float32)
+    keep, ratio = _cutmix_mask(n, h, w)
+    mixed = keep * images + (1.0 - keep) * tf.roll(images, 1, axis=0)
     return dict(
         batch,
         images=mixed,
         mix_labels=tf.roll(batch["labels"], 1, axis=0),
-        ratio=tf.fill([n], ratio),
+        ratio=ratio,
     )
 
 
-def mixup_or_cutmix(
+def mixup_and_cutmix(
     batch: dict, *, mixup_alpha: float = 0.2, cutmix_alpha: float = 1.0
 ) -> dict:
-    """Randomly apply MixUp or CutMix to the batch (reference
-    ``my_mixup_cutmix`` split the batch four ways; choosing per-batch keeps
-    whole-batch vectorization — input_pipeline.py:320-350)."""
-    return tf.cond(
-        tf.random.uniform([]) < 0.5,
-        lambda: mixup(batch, mixup_alpha),
-        lambda: cutmix(batch, cutmix_alpha),
+    """MixUp on the first half of the batch, CutMix on the second half —
+    the reference's combined policy (``my_mixup_cutmix``,
+    input_pipeline.py:328-350), with roll-partners inside each half so the
+    batch size is preserved."""
+    images = tf.cast(batch["images"], tf.float32)
+    labels = batch["labels"]
+    half = tf.shape(images)[0] // 2
+    mu = mixup({"images": images[:half], "labels": labels[:half]}, mixup_alpha)
+    cm = cutmix({"images": images[half:], "labels": labels[half:]}, cutmix_alpha)
+    return dict(
+        batch,
+        images=tf.concat([mu["images"], cm["images"]], axis=0),
+        mix_labels=tf.concat([mu["mix_labels"], cm["mix_labels"]], axis=0),
+        ratio=tf.concat([mu["ratio"], cm["ratio"]], axis=0),
     )
 
 
 def apply_mixes(batch: dict, spec) -> dict:
     """Apply the mix ops selected by an AugmentSpec."""
     if spec.cutmix and spec.mixup:
-        return mixup_or_cutmix(
+        return mixup_and_cutmix(
             batch, mixup_alpha=spec.mixup_alpha, cutmix_alpha=spec.cutmix_alpha
         )
     if spec.mixup:

@@ -243,6 +243,7 @@ def load(
     image_size: int = 224,
     augment_name: Optional[str] = None,
     eval_preproc: str = "crop_resize",
+    augment_before_mix: bool = True,
     transpose: bool = False,
     bfloat16: bool = False,
     fake_data: bool = False,
@@ -256,6 +257,12 @@ def load(
     ``batch_dims``: leading batch shape, outermost first (reference
     semantics: ``[local_devices, per_device_bs]``; pjit callers typically
     pass a single global-per-host dim).
+
+    ``augment_before_mix``: apply RandAugment/AutoAugment before (True,
+    default) or after CutMix/MixUp — the reference's toggle
+    (input_pipeline.py:180-182, 218-222). The after-mix path re-quantizes
+    the mixed images to uint8 for the augment ops, exactly like the
+    reference's ``unbatch → augment_normalize → batch`` stage.
     """
     total_batch = int(np.prod(batch_dims))
 
@@ -294,29 +301,47 @@ def load(
         from sav_tpu.data.augment_spec import parse_augment_spec
 
         spec = parse_augment_spec(augment_name)
+        if pc > 1:
+            # Multi-host training: cache the decoded-source shard on this
+            # host before repeat/shuffle (input_pipeline.py:143-145) — each
+            # host re-reads only memory after epoch 1.
+            ds = ds.cache()
         ds = ds.repeat()
         ds = ds.shuffle(
             shuffle_buffer if shuffle_buffer is not None else 10 * total_batch,
             seed=seed,
         )
     # Eval: no repeat; partial final batches are kept for flat batch_dims
-    # (the eval step just sees a smaller batch) and dropped for nested
-    # batch_dims (a partial batch can't fill the device grid). The reference
-    # instead hard-errored on non-divisible eval sizes
+    # (the trainer pads + masks them, so any mesh shape works) and dropped
+    # for nested batch_dims (a partial batch can't fill the device grid).
+    # The reference instead hard-errored on non-divisible eval sizes
     # (input_pipeline.py:150-152), which crashed the shipped defaults.
+
+    def _augment(image):
+        """RA/AA on a single uint8 HWC image."""
+        if spec.randaugment is not None:
+            from sav_tpu.data.autoaugment import distort_image_with_randaugment
+
+            layers, mag = spec.randaugment
+            return distort_image_with_randaugment(image, layers, mag)
+        if spec.autoaugment:
+            from sav_tpu.data.autoaugment import distort_image_with_autoaugment
+
+            return distort_image_with_autoaugment(image)
+        return image
+
+    aug_after_mix = (
+        is_training
+        and not augment_before_mix
+        and spec.mixes
+        and (spec.randaugment is not None or spec.autoaugment)
+    )
 
     def preprocess(example):
         if is_training:
             image = _train_preprocess(example["image_bytes"], image_size)
-            if spec.randaugment is not None:
-                from sav_tpu.data.autoaugment import distort_image_with_randaugment
-
-                layers, mag = spec.randaugment
-                image = distort_image_with_randaugment(image, layers, mag)
-            elif spec.autoaugment:
-                from sav_tpu.data.autoaugment import distort_image_with_autoaugment
-
-                image = distort_image_with_autoaugment(image)
+            if not aug_after_mix:
+                image = _augment(image)
         else:
             image = _eval_preprocess(example["image_bytes"], image_size, eval_preproc)
         return {"images": image, "labels": tf.cast(example["label"], tf.int32)}
@@ -325,13 +350,32 @@ def load(
     drop_remainder = is_training or len(batch_dims) > 1
     ds = ds.batch(total_batch, drop_remainder=drop_remainder)
 
+    if is_training and spec is not None and spec.mixes:
+        from sav_tpu.data.mix import apply_mixes
+
+        # Mixes run on 0..255 floats before normalization (commutes with the
+        # per-channel affine normalize — see sav_tpu/data/mix.py).
+        ds = ds.map(
+            lambda b: apply_mixes(b, spec), num_parallel_calls=tf.data.AUTOTUNE
+        )
+        if aug_after_mix:
+            # Reference's augment-after-mix stage (input_pipeline.py:218-222):
+            # re-quantize each mixed image to uint8, augment, rebatch.
+            def requant_augment(example):
+                image = tf.cast(
+                    tf.clip_by_value(example["images"], 0.0, 255.0), tf.uint8
+                )
+                return dict(example, images=_augment(image))
+
+            ds = (
+                ds.unbatch()
+                .map(requant_augment, num_parallel_calls=tf.data.AUTOTUNE)
+                .batch(total_batch, drop_remainder=True)
+            )
+
     def finalize(batch):
         batch = dict(batch)
         batch["images"] = _normalize(batch["images"])
-        if is_training and spec is not None and spec.mixes:
-            from sav_tpu.data.mix import apply_mixes
-
-            batch = apply_mixes(batch, spec)
         images = batch["images"]
         lead = list(batch_dims)
         if len(lead) > 1:

@@ -92,7 +92,9 @@ class AttentionBlock(nn.Module):
     use_bias: bool = False
     # One QKV matmul for self-attention (TPU perf). Changes the param tree
     # (to_qkv instead of to_q/to_k/to_v) — set False for the reference's
-    # three-projection layout if a checkpoint/repro needs it.
+    # three-projection layout if a checkpoint/repro needs it, and for any
+    # cross-attention use (Q and K/V come from different inputs). The
+    # checkpoint layout depends on this flag alone, never on call arguments.
     fused_qkv: bool = True
     # RoPE on Q/K after projection (the working rebuild of the reference's
     # broken, never-wired rotary path — SURVEY.md §2.9 #12).
@@ -115,11 +117,17 @@ class AttentionBlock(nn.Module):
             use_bias=self.use_bias,
             dtype=self.dtype,
         )
-        if self.fused_qkv and inputs_q is inputs_kv:
+        if self.fused_qkv:
             # Self-attention: one [in, 3·H·D] matmul instead of three
             # [in, H·D] ones — bigger MXU tiles and the activations are
             # read from HBM once. Same init distribution per column as
             # three separate DenseGenerals (fan_in is identical).
+            if inputs_q is not inputs_kv:
+                raise ValueError(
+                    "fused_qkv=True projects Q, K and V from one input and is "
+                    "only valid for self-attention; pass fused_qkv=False for "
+                    "cross-attention (distinct inputs_q / inputs_kv)."
+                )
             qkv = dense(features=(3, self.num_heads, head_ch), name="to_qkv")(
                 inputs_q
             )

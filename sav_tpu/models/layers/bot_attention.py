@@ -6,8 +6,9 @@ Fixed, working rebuild of the reference's ``BoTMHSA`` + ``RelativeLogits``
 einsum; SURVEY.md §2.9 #1-3). Design: the learned relative tables produce an
 additive logits bias via :func:`sav_tpu.ops.relative.relative_logits_2d`, and
 the attention core is the shared ``dot_product_attention`` — so on the Pallas
-path the relative logits enter the fused flash kernel as a bias and the
-``[B, heads, HW, HW]`` softmax never round-trips HBM.
+path the *forward* pass streams the relative logits through the fused flash
+kernel without materializing the ``[B, heads, HW, HW]`` softmax in HBM (the
+backward recomputes attention; see :mod:`sav_tpu.ops.flash_attention`).
 """
 
 from __future__ import annotations
@@ -77,9 +78,9 @@ class BoTMHSA(nn.Module):
             and height * width >= 256
         )
         if use_fused:
-            # Fully fused path: compact per-axis relative logits expand
-            # inside the flash kernel — the [B, heads, L, L] bias never
-            # exists in HBM (SURVEY.md §7 'hard parts').
+            # Fused forward: compact per-axis relative logits expand inside
+            # the flash kernel, so the forward never materializes the
+            # [B, heads, L, L] bias in HBM (SURVEY.md §7 'hard parts').
             out = flash_botnet_attention(
                 query, key, value, rel_k_h, rel_k_w, height, width, scale=scale
             )

@@ -17,12 +17,18 @@ from sav_tpu.models.layers.attention import AttentionBlock
 class ClassSelfAttentionBlock(AttentionBlock):
     """Query is the first (CLS) token only; K/V span the full sequence."""
 
+    # Q comes from a different (sliced) tensor than K/V — cross-attention
+    # layout, so the fused single-matmul QKV projection does not apply.
+    fused_qkv: bool = False
+
     def __call__(self, inputs: jax.Array, is_training: bool) -> jax.Array:  # type: ignore[override]
         return super().__call__(inputs[:, 0:1], inputs, is_training)
 
 
 class LCSelfAttentionBlock(AttentionBlock):
     """Query is the last token only (CeiT layer-wise class attention)."""
+
+    fused_qkv: bool = False
 
     def __call__(self, inputs: jax.Array, is_training: bool) -> jax.Array:  # type: ignore[override]
         return super().__call__(inputs[:, -1:], inputs, is_training)

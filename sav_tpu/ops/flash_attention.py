@@ -7,11 +7,14 @@ statistics in scratch, and never materializes the ``[B, H, Lq, Lk]`` logits
 in HBM. An optional additive bias input carries 2-D relative-position logits
 (BoTNet) or masks through the fused softmax.
 
-Differentiation: ``flash_attention`` is a ``jax.custom_vjp``; the backward
-pass recomputes attention with XLA einsums (flash-style recompute — no
-saved probabilities). Sequence lengths in the reference's model zoo are
-≤ ~800 tokens, so the O(L²) backward workspace is small; a fully blocked
-Pallas backward is the planned upgrade.
+Differentiation: ``flash_attention`` is a ``jax.custom_vjp``. Without a
+bias, the backward is fully blocked Pallas too: the forward saves only the
+per-row logsumexp (broadcast across one 128-lane tile, the TPU-friendly
+layout), and two kernels recompute probabilities tile-by-tile to produce
+dq (kv-innermost grid) and dk/dv (q-innermost grid) — the ``[B, H, Lq,
+Lk]`` probability matrix never exists in HBM in either direction. With an
+additive bias that requires a gradient, the backward falls back to an XLA
+flash-style recompute (the dbias reduction needs the dense ``ds``).
 
 Numerics: logits/softmax/accumulation in float32 regardless of input dtype;
 the P·V matmul runs in the value dtype on the MXU (bf16 in, f32 accumulate).
@@ -40,10 +43,11 @@ def _round_up(x: int, m: int) -> int:
 
 
 def _online_softmax_step(s, v_ref, o_ref, m_scr, l_scr, acc_scr, ki,
-                         num_kv_blocks):
+                         num_kv_blocks, lse_ref=None):
     """Shared flash epilogue: fold this block's logits ``s`` into the running
-    (max, sum, acc) statistics; write the normalized output on the last
-    kv block."""
+    (max, sum, acc) statistics; write the normalized output (and, when
+    ``lse_ref`` is given, the per-row logsumexp the blocked backward needs)
+    on the last kv block."""
     m_prev = m_scr[:, 0:1]
     l_prev = l_scr[:, 0:1]
     m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
@@ -62,6 +66,10 @@ def _online_softmax_step(s, v_ref, o_ref, m_scr, l_scr, acc_scr, ki,
     @pl.when(ki == num_kv_blocks - 1)
     def _finalize():
         o_ref[0] = (acc_scr[...] / l_scr[:, 0:1]).astype(o_ref.dtype)
+        if lse_ref is not None:
+            # Combined logsumexp, broadcast across the lane tile so the
+            # backward reads it with no relayout.
+            lse_ref[0] = m_scr[...] + jnp.log(l_scr[...])
 
 
 def _kernel(
@@ -70,14 +78,21 @@ def _kernel(
     v_ref,
     *rest,
     has_bias: bool,
+    with_lse: bool,
     scale: float,
     kv_len: int,
     block_kv: int,
     num_kv_blocks: int,
 ):
-    """Online-softmax flash kernel; ``rest`` = ([bias_ref], o_ref, m, l, acc)."""
+    """Online-softmax flash kernel;
+    ``rest`` = ([bias_ref], o_ref, [lse_ref], m, l, acc)."""
     bias_ref = rest[0] if has_bias else None
-    o_ref, m_scr, l_scr, acc_scr = rest[1 if has_bias else 0 :]
+    rest = rest[1 if has_bias else 0 :]
+    if with_lse:
+        o_ref, lse_ref, m_scr, l_scr, acc_scr = rest
+    else:
+        o_ref, m_scr, l_scr, acc_scr = rest
+        lse_ref = None
     ki = pl.program_id(2)
 
     @pl.when(ki == 0)
@@ -99,7 +114,7 @@ def _kernel(
         s = jnp.where(col < kv_len, s, _NEG_INF)
 
     _online_softmax_step(s, v_ref, o_ref, m_scr, l_scr, acc_scr, ki,
-                         num_kv_blocks)
+                         num_kv_blocks, lse_ref=lse_ref)
 
 
 def _flash_forward(
@@ -111,8 +126,14 @@ def _flash_forward(
     block_q: int,
     block_kv: int,
     interpret: Optional[bool],
-) -> jax.Array:
-    """Run the kernel. Layout in/out: ``[B, L, H, D]``."""
+    with_lse: bool = False,
+):
+    """Run the kernel. Layout in/out: ``[B, L, H, D]``.
+
+    With ``with_lse`` also returns the per-row logsumexp as
+    ``[B·H, padded_q_len, 128]`` f32 (value broadcast across the lane dim) —
+    the residual the blocked backward consumes as-is.
+    """
     batch, q_len, heads, dim = q.shape
     kv_len = k.shape[1]
     if interpret is None:
@@ -167,18 +188,29 @@ def _flash_forward(
     kernel = functools.partial(
         _kernel,
         has_bias=bias is not None,
+        with_lse=with_lse,
         scale=scale,
         kv_len=kv_len,
         block_kv=block_kv,
         num_kv_blocks=num_kv_blocks,
     )
 
-    out = pl.pallas_call(
+    out_specs = [pl.BlockSpec((1, block_q, dim_p), lambda b, i, j: (b, i, 0))]
+    out_shape = [jax.ShapeDtypeStruct((batch * heads, q_len_p, dim_p), q.dtype)]
+    if with_lse:
+        out_specs.append(
+            pl.BlockSpec((1, block_q, 128), lambda b, i, j: (b, i, 0))
+        )
+        out_shape.append(
+            jax.ShapeDtypeStruct((batch * heads, q_len_p, 128), jnp.float32)
+        )
+
+    outs = pl.pallas_call(
         kernel,
         grid=grid,
         in_specs=in_specs,
-        out_specs=pl.BlockSpec((1, block_q, dim_p), lambda b, i, j: (b, i, 0)),
-        out_shape=jax.ShapeDtypeStruct((batch * heads, q_len_p, dim_p), q.dtype),
+        out_specs=out_specs,
+        out_shape=out_shape,
         scratch_shapes=[
             pltpu.VMEM((block_q, 128), jnp.float32),
             pltpu.VMEM((block_q, 128), jnp.float32),
@@ -187,9 +219,211 @@ def _flash_forward(
         interpret=interpret,
     )(*args)
 
-    out = out[:, :q_len, :dim]
+    out = outs[0][:, :q_len, :dim]
     out = out.reshape(batch, heads, q_len, dim)
-    return jnp.transpose(out, (0, 2, 1, 3))
+    out = jnp.transpose(out, (0, 2, 1, 3))
+    if with_lse:
+        return out, outs[1]
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Blocked Pallas backward (no-bias path). Standard flash backward with the
+# normalized-probability formulation: the forward saves lse = m + log(l),
+# so p = exp(s − lse) is already normalized, and with
+# delta_i = Σ_d dO_id · O_id the gradients are
+#   ds = p ⊙ (dO·Vᵀ − delta),  dq = scale·ds·K,  dk = scale·dsᵀ·Q,
+#   dv = pᵀ·dO.
+# dq uses a kv-innermost grid (accumulator indexed by q block); dk/dv use a
+# q-innermost grid (accumulators indexed by kv block). All matmuls run
+# bf16-in/f32-accumulate on the MXU — feeding fp32 operands to the MXU would
+# run it at a fraction of peak for no accuracy gain (same policy as the XLA
+# recompute path below).
+# ---------------------------------------------------------------------------
+
+
+def _lanes(x: jax.Array, n: int) -> jax.Array:
+    """Expand a [rows, 128] lane-broadcast tile to ``n`` lanes."""
+    if n == 128:
+        return x
+    if n % 128 == 0:
+        return jnp.tile(x, (1, n // 128))
+    return jnp.broadcast_to(x[:, 0:1], (x.shape[0], n))
+
+
+def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
+                   dq_acc, *, scale: float, q_len: int, kv_len: int,
+                   block_q: int, block_kv: int, num_kv_blocks: int):
+    ki = pl.program_id(2)
+
+    @pl.when(ki == 0)
+    def _init():
+        dq_acc[...] = jnp.zeros_like(dq_acc)
+
+    q, k, v, do = q_ref[0], k_ref[0], v_ref[0], do_ref[0]
+    s = jax.lax.dot_general(
+        q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+    ) * scale
+    p = jnp.exp(s - _lanes(lse_ref[0], s.shape[1]))
+    if kv_len % block_kv != 0:
+        col = ki * block_kv + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+        p = jnp.where(col < kv_len, p, 0.0)
+    if q_len % block_q != 0:
+        # Padded (zero) q rows carry a finite lse ≈ log(kv_len), so p is
+        # finite garbage, not NaN; their dq rows are sliced off outside.
+        # Zero them anyway so the padded rows cost nothing downstream and
+        # the invariant "p == 0 outside the real block" holds in both
+        # backward kernels.
+        row = pl.program_id(1) * block_q + jax.lax.broadcasted_iota(
+            jnp.int32, s.shape, 0
+        )
+        p = jnp.where(row < q_len, p, 0.0)
+    dp = jax.lax.dot_general(
+        do, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+    )
+    ds = p * (dp - _lanes(delta_ref[0], s.shape[1]))
+    dq_acc[...] += jax.lax.dot_general(
+        ds.astype(k.dtype), k, (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    ) * scale
+
+    @pl.when(ki == num_kv_blocks - 1)
+    def _write():
+        dq_ref[0] = dq_acc[...].astype(dq_ref.dtype)
+
+
+def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref,
+                    dv_ref, dk_acc, dv_acc, *, scale: float, q_len: int,
+                    block_q: int, num_q_blocks: int):
+    qi = pl.program_id(2)
+
+    @pl.when(qi == 0)
+    def _init():
+        dk_acc[...] = jnp.zeros_like(dk_acc)
+        dv_acc[...] = jnp.zeros_like(dv_acc)
+
+    q, k, v, do = q_ref[0], k_ref[0], v_ref[0], do_ref[0]
+    s = jax.lax.dot_general(
+        q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+    ) * scale  # [block_q, block_kv]
+    p = jnp.exp(s - _lanes(lse_ref[0], s.shape[1]))
+    if q_len % block_q != 0:
+        # Padded q rows must not contribute to the dk/dv sums.
+        row = qi * block_q + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
+        p = jnp.where(row < q_len, p, 0.0)
+    dv_acc[...] += jax.lax.dot_general(
+        p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+    dp = jax.lax.dot_general(
+        do, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+    )
+    ds = p * (dp - _lanes(delta_ref[0], s.shape[1]))
+    dk_acc[...] += jax.lax.dot_general(
+        ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    ) * scale
+
+    @pl.when(qi == num_q_blocks - 1)
+    def _write():
+        dk_ref[0] = dk_acc[...].astype(dk_ref.dtype)
+        dv_ref[0] = dv_acc[...].astype(dv_ref.dtype)
+
+
+def _flash_backward_pallas(q, k, v, out, lse, g, scale, block_q, block_kv,
+                           interpret):
+    """Blocked backward; q/k/v/out/g are ``[B, L, H, D]``, lse is the padded
+    ``[B·H, q_len_p, 128]`` forward residual."""
+    batch, q_len, heads, dim = q.shape
+    kv_len = k.shape[1]
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+
+    def to_bhld(x):
+        b, l, h, d = x.shape
+        return jnp.transpose(x, (0, 2, 1, 3)).reshape(b * h, l, d)
+
+    dim_p = _round_up(dim, 128)
+    block_q = min(block_q, _round_up(q_len, 16))
+    block_kv = min(block_kv, _round_up(kv_len, 16))
+    q_len_p = _round_up(q_len, block_q)
+    kv_len_p = _round_up(kv_len, block_kv)
+
+    def pad3(x, lp):
+        return jnp.pad(x, ((0, 0), (0, lp - x.shape[1]), (0, dim_p - x.shape[2])))
+
+    qf = pad3(to_bhld(q), q_len_p)
+    kf = pad3(to_bhld(k), kv_len_p)
+    vf = pad3(to_bhld(v), kv_len_p)
+    dof = pad3(to_bhld(g), q_len_p)
+
+    # delta_i = Σ_d dO·O per query row, broadcast across one lane tile
+    # (same layout as lse so the kernels read both with no relayout).
+    delta = jnp.sum(
+        g.astype(jnp.float32) * out.astype(jnp.float32), axis=-1
+    )  # [B, Lq, H]
+    delta = jnp.transpose(delta, (0, 2, 1)).reshape(batch * heads, q_len)
+    delta = jnp.pad(delta, ((0, 0), (0, q_len_p - q_len)))
+    delta = jnp.broadcast_to(delta[:, :, None], delta.shape + (128,))
+
+    num_q_blocks = q_len_p // block_q
+    num_kv_blocks = kv_len_p // block_kv
+    bh = batch * heads
+
+    qspec = pl.BlockSpec((1, block_q, dim_p), lambda b, i, j: (b, i, 0))
+    kspec = pl.BlockSpec((1, block_kv, dim_p), lambda b, i, j: (b, j, 0))
+    rowq = pl.BlockSpec((1, block_q, 128), lambda b, i, j: (b, i, 0))
+
+    dq = pl.pallas_call(
+        functools.partial(
+            _bwd_dq_kernel,
+            scale=scale,
+            q_len=q_len,
+            kv_len=kv_len,
+            block_q=block_q,
+            block_kv=block_kv,
+            num_kv_blocks=num_kv_blocks,
+        ),
+        grid=(bh, num_q_blocks, num_kv_blocks),
+        in_specs=[qspec, kspec, kspec, qspec, rowq, rowq],
+        out_specs=qspec,
+        out_shape=jax.ShapeDtypeStruct((bh, q_len_p, dim_p), q.dtype),
+        scratch_shapes=[pltpu.VMEM((block_q, dim_p), jnp.float32)],
+        interpret=interpret,
+    )(qf, kf, vf, dof, lse, delta)
+
+    # q-innermost grid for dk/dv: block index 1 is the kv block, index 2
+    # sweeps q blocks into the accumulators.
+    qspec2 = pl.BlockSpec((1, block_q, dim_p), lambda b, j, i: (b, i, 0))
+    kspec2 = pl.BlockSpec((1, block_kv, dim_p), lambda b, j, i: (b, j, 0))
+    rowq2 = pl.BlockSpec((1, block_q, 128), lambda b, j, i: (b, i, 0))
+    dk, dv = pl.pallas_call(
+        functools.partial(
+            _bwd_dkv_kernel,
+            scale=scale,
+            q_len=q_len,
+            block_q=block_q,
+            num_q_blocks=num_q_blocks,
+        ),
+        grid=(bh, num_kv_blocks, num_q_blocks),
+        in_specs=[qspec2, kspec2, kspec2, qspec2, rowq2, rowq2],
+        out_specs=[kspec2, kspec2],
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, kv_len_p, dim_p), k.dtype),
+            jax.ShapeDtypeStruct((bh, kv_len_p, dim_p), v.dtype),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_kv, dim_p), jnp.float32),
+            pltpu.VMEM((block_kv, dim_p), jnp.float32),
+        ],
+        interpret=interpret,
+    )(qf, kf, vf, dof, lse, delta)
+
+    def from_bhld(x, l):
+        x = x[:, :l, :dim].reshape(batch, heads, l, dim)
+        return jnp.transpose(x, (0, 2, 1, 3))
+
+    return from_bhld(dq, q_len), from_bhld(dk, kv_len), from_bhld(dv, kv_len)
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7))
@@ -198,19 +432,25 @@ def _flash(q, k, v, bias, scale, block_q, block_kv, interpret):
 
 
 def _flash_fwd(q, k, v, bias, scale, block_q, block_kv, interpret):
+    if bias is None:
+        out, lse = _flash_forward(
+            q, k, v, bias, scale, block_q, block_kv, interpret, with_lse=True
+        )
+        return out, (q, k, v, bias, out, lse)
     out = _flash_forward(q, k, v, bias, scale, block_q, block_kv, interpret)
-    return out, (q, k, v, bias)
+    return out, (q, k, v, bias, None, None)
 
 
 def _flash_bwd(scale, block_q, block_kv, interpret, residuals, g):
-    """Flash-style recompute backward in XLA.
-
-    Softmax statistics stay fp32, but every matmul runs with the *input*
-    dtype of q/k/v (bf16 in training) and fp32 MXU accumulation
-    (``preferred_element_type``) — feeding fp32 operands to the MXU would
-    run it at a fraction of peak for no accuracy gain over bf16-in/f32-acc.
-    """
-    q, k, v, bias = residuals
+    """Backward dispatch: blocked Pallas kernels when there is no bias;
+    XLA flash-style recompute when a dbias is needed (the dense ``ds`` is
+    unavoidable for the bias gradient)."""
+    q, k, v, bias, out, lse = residuals
+    if bias is None:
+        dq, dk, dv = _flash_backward_pallas(
+            q, k, v, out, lse, g, scale, block_q, block_kv, interpret
+        )
+        return dq, dk, dv, None
     del block_q, block_kv, interpret
     mm_dtype = q.dtype
     s = jnp.einsum("bqhd,bkhd->bhqk", q, k, preferred_element_type=jnp.float32) * scale

@@ -278,12 +278,19 @@ class Trainer:
         labels = batch["labels"]
         onehot = jax.nn.one_hot(labels, self.config.num_classes, dtype=jnp.float32)
         n = labels.shape[0]
+        # 'valid' marks real rows in a padded final batch (evaluate() pads
+        # remainders so every batch has one static, mesh-divisible shape).
+        valid = batch.get("valid")
+        if valid is None:
+            valid = jnp.ones((n,), jnp.float32)
         acc = topk_correct(logits, labels)
+        logp = jax.nn.log_softmax(logits, axis=-1)
+        per_example_loss = -jnp.sum(onehot * logp, axis=-1)
         return {
-            "loss_sum": cross_entropy(logits, onehot) * n,
-            "top_1_sum": jnp.sum(acc["top_1_acc"]),
-            "top_5_sum": jnp.sum(acc["top_5_acc"]),
-            "count": jnp.asarray(n, jnp.float32),
+            "loss_sum": jnp.sum(per_example_loss * valid),
+            "top_1_sum": jnp.sum(acc["top_1_acc"] * valid),
+            "top_5_sum": jnp.sum(acc["top_5_acc"] * valid),
+            "count": jnp.sum(valid),
         }
 
     # ------------------------------------------------------------- data flow
@@ -310,9 +317,41 @@ class Trainer:
     def eval_step(self, state: TrainState, batch: dict):
         return self._eval_step(state, self.shard_batch(batch))
 
+    def _pad_eval_batch(self, batch: dict, target: int) -> dict:
+        """Zero-pad a partial final batch to ``target`` rows + 'valid' mask.
+
+        Keeps eval at one compiled shape and makes any eval size work on any
+        mesh (the reference hard-errored on non-divisible eval batches,
+        input_pipeline.py:150-152)."""
+        n = len(batch["labels"])
+        pad = target - n
+        transposed = self.config.transpose_images
+
+        def pad_leaf(key, x):
+            x = np.asarray(x)
+            axis = x.ndim - 1 if (key == "images" and transposed) else 0
+            widths = [(0, 0)] * x.ndim
+            widths[axis] = (0, pad)
+            return np.pad(x, widths)
+
+        out = {k: pad_leaf(k, v) for k, v in batch.items()}
+        out["valid"] = np.concatenate(
+            [np.ones(n, np.float32), np.zeros(pad, np.float32)]
+        )
+        return out
+
     def evaluate(self, state: TrainState, eval_iter: Iterator[dict]) -> dict:
         totals: dict[str, float] = {}
+        batch_size: Optional[int] = None
+        data_div = int(np.prod([self.mesh.shape[a] for a in batch_axes(self.mesh)]))
         for batch in eval_iter:
+            n = len(batch["labels"])
+            if batch_size is None:
+                # First batch fixes the compiled shape: its size rounded up
+                # to a mesh-divisible multiple (so a tiny eval set shards).
+                batch_size = -(-n // data_div) * data_div
+            if n < batch_size:
+                batch = self._pad_eval_batch(batch, batch_size)
             sums = jax.device_get(self.eval_step(state, batch))
             for k, v in sums.items():
                 totals[k] = totals.get(k, 0.0) + float(v)

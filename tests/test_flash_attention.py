@@ -67,6 +67,47 @@ def test_flash_gradients_match_xla():
         np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=5e-5, rtol=5e-4)
 
 
+@pytest.mark.parametrize(
+    "lq,lk,d",
+    [
+        (197, 197, 64),  # DeiT-S/16 @ 224 — the flagship backward shape
+        (128, 128, 128),  # aligned
+        (50, 50, 32),  # unaligned: padded q rows + kv cols in both kernels
+        (1, 197, 64),  # class attention: single query row
+        (196, 49, 64),  # CvT: downsampled K/V
+        (320, 256, 40),  # multi-block q and kv, odd head dim
+    ],
+)
+def test_flash_blocked_backward_matches_xla(lq, lk, d):
+    """No-bias gradients run the blocked Pallas backward kernels."""
+    q, k, v = _qkv(lq=lq, lk=lk, d=d)
+
+    def loss_f(fn):
+        return lambda q, k, v: jnp.sum(jnp.square(fn(q, k, v)))
+
+    gf = jax.grad(loss_f(flash_attention), argnums=(0, 1, 2))(q, k, v)
+    gx = jax.grad(loss_f(xla_attention), argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(gf, gx):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), atol=1e-4, rtol=5e-4
+        )
+
+
+def test_flash_blocked_backward_bf16_finite_and_close():
+    q, k, v = _qkv(lq=197, lk=197, d=64, dtype=jnp.bfloat16)
+
+    def loss(fn, q, k, v):
+        return jnp.sum(jnp.square(fn(q, k, v).astype(jnp.float32)))
+
+    gf = jax.grad(lambda *a: loss(flash_attention, *a), argnums=(0, 1, 2))(q, k, v)
+    gx = jax.grad(lambda *a: loss(xla_attention, *a), argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(gf, gx):
+        a, b = np.asarray(a, np.float32), np.asarray(b, np.float32)
+        assert np.isfinite(a).all()
+        # bf16 tolerance: both paths quantize differently.
+        np.testing.assert_allclose(a, b, atol=0.15, rtol=0.15)
+
+
 def test_flash_bf16():
     q, k, v = _qkv(lq=197, lk=197, d=64, dtype=jnp.bfloat16)
     ref = xla_attention(q, k, v)

@@ -30,12 +30,22 @@ def test_attention_block_shapes():
 
 
 def test_attention_cross():
-    block = layers.AttentionBlock(num_heads=2, out_ch=24)
+    block = layers.AttentionBlock(num_heads=2, out_ch=24, fused_qkv=False)
     q = jax.random.normal(jax.random.PRNGKey(0), (2, 5, 16))
     kv = jax.random.normal(jax.random.PRNGKey(1), (2, 9, 16))
     variables = block.init(_rngs(), q, kv, is_training=False)
     out = block.apply(variables, q, kv, is_training=False)
     chex.assert_shape(out, (2, 5, 24))
+
+
+def test_attention_cross_with_fused_qkv_raises():
+    """The QKV layout depends on the fused_qkv flag alone; cross-attention
+    with fused_qkv=True is an explicit error, never a silent layout change."""
+    block = layers.AttentionBlock(num_heads=2)
+    q = jax.random.normal(jax.random.PRNGKey(0), (2, 5, 16))
+    kv = jax.random.normal(jax.random.PRNGKey(1), (2, 9, 16))
+    with pytest.raises(ValueError, match="fused_qkv"):
+        block.init(_rngs(), q, kv, is_training=False)
 
 
 def test_talking_heads_changes_result():

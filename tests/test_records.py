@@ -179,6 +179,25 @@ def test_corrupt_num_records_rejected(tmp_path, recfile):
         SavRecDataset(str(bad))
 
 
+def test_header_only_file_rejected(tmp_path, recfile):
+    """A valid 0x28-byte header with no offsets table must fail open.
+
+    Regression test for the native truncation guard: with zero u64 slots
+    after the header, ``avail - 1`` underflowed and the guard passed, so
+    ``offsets[num_records]`` read far past the mapping (ADVICE round 1).
+    """
+    path, _, _ = recfile
+    header = open(path, "rb").read()[:0x28]
+    for n in (0, 1, 7):
+        data = bytearray(header)
+        import struct as _s
+        _s.pack_into("<Q", data, 0x10, n)
+        bad = tmp_path / f"header_only_{n}.savrec"
+        bad.write_bytes(data)
+        with pytest.raises(ValueError, match="SavRecord"):
+            SavRecDataset(str(bad))
+
+
 def test_corrupt_offsets_rejected(tmp_path, recfile):
     path, _, _ = recfile
     data = bytearray(open(path, "rb").read())

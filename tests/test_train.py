@@ -266,3 +266,39 @@ def test_grad_accum_rejects_indivisible(devices):
     batch = next(synthetic_data_iterator(batch_size=16, image_size=16, num_classes=10))
     with pytest.raises(ValueError, match="not divisible"):
         trainer.train_step(state, batch, jax.random.PRNGKey(0))
+
+
+def test_eval_pads_non_divisible_final_batch(devices):
+    """50 eval examples in batches of 16 leave a remainder of 2 — not
+    divisible by the 8-way data axis. evaluate() must pad + mask instead of
+    crashing, and count exactly 50 examples."""
+    trainer = _trainer()
+    state = trainer.init_state()
+
+    def eval_iter():
+        rng = np.random.default_rng(0)
+        remaining = 50
+        while remaining > 0:
+            n = min(16, remaining)
+            yield {
+                "images": rng.standard_normal((n, 32, 32, 3)).astype(np.float32),
+                "labels": rng.integers(0, 10, (n,), dtype=np.int32),
+            }
+            remaining -= n
+
+    metrics = trainer.evaluate(state, eval_iter())
+    assert metrics["eval_count"] == 50.0
+    assert 0.0 <= metrics["eval_top_1_acc"] <= 1.0
+
+
+def test_eval_tiny_set_smaller_than_mesh(devices):
+    """A 3-example eval set on an 8-way data axis must still work."""
+    trainer = _trainer()
+    state = trainer.init_state()
+    rng = np.random.default_rng(1)
+    batch = {
+        "images": rng.standard_normal((3, 32, 32, 3)).astype(np.float32),
+        "labels": rng.integers(0, 10, (3,), dtype=np.int32),
+    }
+    metrics = trainer.evaluate(state, iter([batch]))
+    assert metrics["eval_count"] == 3.0

@@ -2,32 +2,37 @@
 """Headline benchmark: DeiT-S/16 ImageNet-shape training throughput per chip.
 
 Measures the full jitted train step (forward + backward + AdamW update,
-bf16 compute, label smoothing) on synthetic 224² batches — the
-BASELINE.json north-star metric (target ≥8,000 img/s/chip). Prints exactly
-one JSON line:
+bf16 compute, label smoothing) — the BASELINE.json north-star metric
+(target ≥8,000 img/s/chip). Prints exactly one JSON line:
 
-    {"metric": ..., "value": N, "unit": "img/s/chip", "vs_baseline": N}
+    {"metric": ..., "value": N, "unit": "img/s/chip", "vs_baseline": N, ...}
 
-``vs_baseline`` is value / 8000 (the driver-set north star; the reference
-itself published no numbers — BASELINE.md).
+``value`` is the best-window throughput (the shared/tunneled benchmark chip
+shows >5x transient slowdowns; the minimum step time is the honest
+hardware-capability number) and ``median_img_per_sec_per_chip`` is the
+median window — both reported so the methodology is transparent
+(ADVICE r1). ``mfu`` is model-FLOPs utilization from the compiled step's
+XLA cost analysis against the chip's peak bf16 FLOP/s.
+
+Feeds (``--feed``):
+  synthetic — one device-resident batch, re-stepped (pure device number)
+  pipeline  — the real tf.data path (JPEG bytes → crops → RandAugment →
+              CutMix/MixUp) over an in-memory source, feeding the real
+              train step; also reports the host pipeline's own img/s
+  savrec    — the native SavRecord mmap loader feeding the train step
 """
 
 from __future__ import annotations
 
 import argparse
 import json
+import statistics
 import sys
 import time
 
 BASELINE_IMG_PER_SEC_PER_CHIP = 8000.0
 
-
-def run(model_name: str, batch_size: int, steps: int, backend, image_size: int,
-        reps: int = 4):
-    import jax
-    import numpy as np
-
-    from sav_tpu.data import synthetic_data_iterator
+def _make_trainer(model_name, batch_size, backend, image_size):
     from sav_tpu.train import TrainConfig, Trainer
 
     config = TrainConfig(
@@ -35,46 +40,145 @@ def run(model_name: str, batch_size: int, steps: int, backend, image_size: int,
         num_classes=1000,
         image_size=image_size,
         compute_dtype="bfloat16",
-        attention_backend=backend,
+        attention_backend=None if backend == "auto" else backend,
         global_batch_size=batch_size,
         transpose_images=False,
         clip_grad_norm=1.0,
         seed=0,
     )
-    trainer = Trainer(config)
-    state = trainer.init_state()
-    batch = next(
-        synthetic_data_iterator(
-            batch_size=batch_size,
+    return Trainer(config)
+
+
+def _feed_iterator(feed, batch_size, image_size, tmpdir):
+    """Host-side batch stream for the fed modes."""
+    import numpy as np
+
+    if feed == "pipeline":
+        from sav_tpu.data.pipeline import Split, load
+
+        rng = np.random.default_rng(0)
+        n = max(4 * batch_size, 2048)
+        images = rng.integers(0, 256, (n, image_size, image_size, 3), np.uint8)
+        labels = rng.integers(0, 1000, (n,), np.int64)
+        return load(
+            Split.TRAIN,
+            source=(images, labels),
+            is_training=True,
+            batch_dims=[batch_size],
             image_size=image_size,
-            num_classes=1000,
-            learnable=False,
+            augment_name="cutmix_mixup_randaugment_405",
+            seed=0,
+            process_index=0,
+            process_count=1,
         )
-    )
-    sharded = trainer.shard_batch(batch)
+    if feed == "savrec":
+        import os
+
+        from sav_tpu.data.records import (
+            SavRecDataset,
+            savrec_train_iterator,
+            write_savrec,
+        )
+
+        rng = np.random.default_rng(0)
+        n = max(4 * batch_size, 2048)
+        path = os.path.join(tmpdir, "bench.savrec")
+        if not os.path.exists(path):
+            write_savrec(
+                path,
+                rng.integers(0, 256, (n, image_size, image_size, 3), np.uint8),
+                rng.integers(0, 1000, (n,), np.int32),
+            )
+        ds = SavRecDataset(path)
+        return savrec_train_iterator(ds, batch_size=batch_size, seed=0)
+    raise ValueError(feed)
+
+
+def run(model_name, batch_size, steps, backend, image_size, reps, feed):
+    import jax
+
+    from sav_tpu.data import synthetic_data_iterator
+
+    trainer = _make_trainer(model_name, batch_size, backend, image_size)
+    state = trainer.init_state()
     rng = jax.random.PRNGKey(0)
+    result: dict = {}
 
-    # Warmup/compile (2 steps: first compiles, second confirms steady state).
-    # Sync via device_get of the loss value — on relayed/remote platforms
-    # block_until_ready alone can return before execution completes.
-    for _ in range(2):
-        state, metrics = trainer._train_step(state, sharded, rng)
-    float(jax.device_get(metrics["loss"]))
+    if feed == "synthetic":
+        batch = next(
+            synthetic_data_iterator(
+                batch_size=batch_size,
+                image_size=image_size,
+                num_classes=1000,
+                learnable=False,
+            )
+        )
+        sharded = trainer.shard_batch(batch)
 
-    # Best of ``reps`` timed windows: the benchmark chip is shared/tunneled
-    # and single windows show >5x transient slowdowns from contention; the
-    # minimum step time is the honest hardware-capability number.
-    best = float("inf")
-    for _ in range(reps):
-        t0 = time.perf_counter()
-        for _ in range(steps):
-            state, metrics = trainer._train_step(state, sharded, rng)
+        # One AOT compile: the measurement loop runs the same executable the
+        # cost analysis comes from (AOT .compile() does not populate the jit
+        # dispatch cache, so mixing AOT + jit would compile twice).
+        from sav_tpu.utils.flops import compiled_flops, per_chip_peak_flops
+
+        step = trainer._train_step.lower(state, sharded, rng).compile()
+        flops = compiled_flops(step) or None
+
+        # Warmup. Sync via device_get of the loss value — on relayed/remote
+        # platforms block_until_ready alone can return before execution
+        # completes.
+        for _ in range(2):
+            state, metrics = step(state, sharded, rng)
         float(jax.device_get(metrics["loss"]))
-        best = min(best, (time.perf_counter() - t0) / steps)
+
+        windows = []
+        for _ in range(reps):
+            t0 = time.perf_counter()
+            for _ in range(steps):
+                state, metrics = step(state, sharded, rng)
+            float(jax.device_get(metrics["loss"]))
+            windows.append((time.perf_counter() - t0) / steps)
+        if flops is not None:
+            # cost_analysis FLOPs are per-device → MFU is per chip.
+            peak = per_chip_peak_flops()
+            if peak:
+                result["mfu"] = round(flops / min(windows) / peak, 4)
+            result["step_flops_per_device"] = flops
+    else:
+        import tempfile
+
+        tmpdir = tempfile.mkdtemp(prefix="sav_bench_")
+        # Host-only pipeline rate (how fast the input side alone can go).
+        it = _feed_iterator(feed, batch_size, image_size, tmpdir)
+        for _ in range(2):
+            next(it)  # warm caches / tf.data autotune
+        t0 = time.perf_counter()
+        host_steps = max(steps // 2, 5)
+        for _ in range(host_steps):
+            next(it)
+        host_rate = batch_size * host_steps / (time.perf_counter() - t0)
+        result["host_pipeline_img_per_sec"] = round(host_rate, 1)
+
+        # End-to-end: pipeline feeding the real train step.
+        it = _feed_iterator(feed, batch_size, image_size, tmpdir)
+        state, metrics = trainer.train_step(state, next(it), rng)
+        float(jax.device_get(metrics["loss"]))
+        windows = []
+        for _ in range(reps):
+            t0 = time.perf_counter()
+            for _ in range(steps):
+                state, metrics = trainer.train_step(state, next(it), rng)
+            float(jax.device_get(metrics["loss"]))
+            windows.append((time.perf_counter() - t0) / steps)
 
     n_chips = len(jax.devices())
-    img_per_sec = batch_size / best
-    return img_per_sec / n_chips, n_chips, best
+    best = min(windows)
+    result.update(
+        best_step_ms=round(best * 1e3, 2),
+        median_img_per_sec_per_chip=round(
+            batch_size / statistics.median(windows) / n_chips, 1
+        ),
+    )
+    return batch_size / best / n_chips, n_chips, result
 
 
 def main(argv=None):
@@ -87,30 +191,37 @@ def main(argv=None):
         "--backend",
         default="xla",
         choices=["xla", "pallas", "auto"],
-        help="attention backend (XLA fuses best at 197-token DeiT shapes today)",
+        help="attention backend (measured crossover: XLA wins at ≤~800-token "
+        "DeiT/CaiT shapes, the fused kernels win on memory at long L — "
+        "see PERF.md)",
+    )
+    parser.add_argument(
+        "--feed",
+        default="synthetic",
+        choices=["synthetic", "pipeline", "savrec"],
+        help="synthetic = device-resident batch; pipeline/savrec = real "
+        "input paths feeding the train step",
     )
     parser.add_argument(
         "--reps", type=int, default=4,
-        help="timed windows; the best one is reported (shared-chip noise)",
+        help="timed windows; best and median are both reported",
     )
     args = parser.parse_args(argv)
 
-    value, n_chips, step_s = run(
-        args.model, args.batch_size, args.steps, args.backend, args.image_size,
-        reps=args.reps,
+    value, n_chips, extra = run(
+        args.model, args.batch_size, args.steps, args.backend,
+        args.image_size, reps=args.reps, feed=args.feed,
     )
-    print(
-        json.dumps(
-            {
-                "metric": f"{args.model} train img/s/chip (bs={args.batch_size}, "
-                f"bf16, {args.backend} attention, {n_chips} chip, "
-                f"best of {args.reps}x{args.steps}-step windows)",
-                "value": round(value, 1),
-                "unit": "img/s/chip",
-                "vs_baseline": round(value / BASELINE_IMG_PER_SEC_PER_CHIP, 4),
-            }
-        )
-    )
+    out = {
+        "metric": f"{args.model} train img/s/chip (bs={args.batch_size}, "
+        f"bf16, {args.backend} attention, {args.feed} feed, {n_chips} chip, "
+        f"best of {args.reps}x{args.steps}-step windows)",
+        "value": round(value, 1),
+        "unit": "img/s/chip",
+        "vs_baseline": round(value / BASELINE_IMG_PER_SEC_PER_CHIP, 4),
+    }
+    out.update(extra)
+    print(json.dumps(out))
     return 0
 
 

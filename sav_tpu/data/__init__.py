@@ -24,13 +24,14 @@ __all__ = [
     "synthetic_data_iterator",
     "load",
     "Split",
+    "resumable_train_iterator",
 ]
 
 
 def __getattr__(name):
     # pipeline (and its TF import) loads lazily so fake/synthetic paths work
     # in TF-free contexts.
-    if name in ("load", "Split"):
+    if name in ("load", "Split", "resumable_train_iterator"):
         from sav_tpu.data import pipeline
 
         return getattr(pipeline, name)

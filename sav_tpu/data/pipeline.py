@@ -34,6 +34,16 @@ try:  # TF is only needed for the real pipeline, not for fake data.
     import tensorflow as tf
 except ImportError:  # pragma: no cover
     tf = None
+else:
+    # TF must never claim the accelerator — it serves host-side data only
+    # while JAX owns the TPU (the reference fought exactly this battle,
+    # input_pipeline.py:228-231; on single-tenant TPU leases a TF claim can
+    # deadlock JAX's device init outright).
+    try:
+        tf.config.set_visible_devices([], "TPU")
+        tf.config.set_visible_devices([], "GPU")
+    except Exception:  # pragma: no cover - older TF / no such device type
+        pass
 
 try:
     import ml_dtypes
@@ -65,11 +75,16 @@ class Split(enum.Enum):
 
 
 def _host_shard_range(
-    split: Split, process_index: int, process_count: int
+    split: Split,
+    process_index: int,
+    process_count: int,
+    split_examples: Optional[int] = None,
 ) -> tuple[int, int]:
     """[start, end) absolute example indices for this host
-    (input_pipeline.py:369-380 behavior)."""
-    arange = np.arange(split.num_examples)
+    (input_pipeline.py:369-380 behavior). ``split_examples`` overrides the
+    ImageNet-sized split for custom TFRecord datasets."""
+    n = split.num_examples if split_examples is None else split_examples
+    arange = np.arange(n)
     shard = np.array_split(arange, process_count)[process_index]
     # VALID lives at the tail of TRAIN_AND_VALID (train[:10000] carve-out in
     # the reference is from the front of tfds train; we use offsets below).
@@ -79,20 +94,30 @@ def _host_shard_range(
 # --------------------------------------------------------------- decoding
 
 
-def _distorted_bbox_crop_window(image_bytes: "tf.Tensor") -> "tf.Tensor":
+def _distorted_bbox_crop_window(
+    image_bytes: "tf.Tensor", stateless_seed=None
+) -> "tf.Tensor":
     """Inception-style random crop window on raw JPEG bytes
-    (input_pipeline.py:479-497)."""
+    (input_pipeline.py:479-497). With ``stateless_seed`` the draw is a pure
+    function of the seed (``sample_distorted_bounding_box`` ignores the
+    graph-level seed, so replayable pipelines must use the stateless op)."""
     shape = tf.image.extract_jpeg_shape(image_bytes)
     bbox = tf.constant([0.0, 0.0, 1.0, 1.0], shape=[1, 1, 4])
-    begin, size, _ = tf.image.sample_distorted_bounding_box(
-        shape,
+    kwargs = dict(
         bounding_boxes=bbox,
         min_object_covered=0.1,
         aspect_ratio_range=(3.0 / 4.0, 4.0 / 3.0),
         area_range=(0.08, 1.0),
-        max_attempts=10,
         use_image_if_no_bounding_boxes=True,
     )
+    if stateless_seed is not None:
+        begin, size, _ = tf.image.stateless_sample_distorted_bounding_box(
+            shape, seed=stateless_seed, **kwargs
+        )
+    else:
+        begin, size, _ = tf.image.sample_distorted_bounding_box(
+            shape, max_attempts=10, **kwargs
+        )
     y, x, _ = tf.unstack(begin)
     h, w, _ = tf.unstack(size)
     return tf.stack([y, x, h, w])
@@ -122,10 +147,19 @@ def _resize_bicubic(image, image_size: int):
     return tf.cast(tf.clip_by_value(out, 0.0, 255.0), tf.uint8)
 
 
-def _train_preprocess(image_bytes, image_size: int):
-    window = _distorted_bbox_crop_window(image_bytes)
-    image = _decode_crop(image_bytes, window)
-    image = tf.image.random_flip_left_right(image)
+def _train_preprocess(image_bytes, image_size: int, stateless_seed=None):
+    if stateless_seed is None:
+        window = _distorted_bbox_crop_window(image_bytes)
+        image = _decode_crop(image_bytes, window)
+        image = tf.image.random_flip_left_right(image)
+    else:
+        window = _distorted_bbox_crop_window(
+            image_bytes, stateless_seed=stateless_seed
+        )
+        image = _decode_crop(image_bytes, window)
+        image = tf.image.stateless_random_flip_left_right(
+            image, seed=stateless_seed + tf.constant([0, 1], tf.int64)
+        )
     return _resize_bicubic(image, image_size)
 
 
@@ -180,10 +214,13 @@ def _tfds_source(split: Split, data_dir, start: int, end: int, is_training: bool
     return ds.map(lambda d: {"image_bytes": d["image"], "label": d["label"]})
 
 
-def _tfrecord_source(split: Split, data_dir: str, start: int, end: int):
+def _tfrecord_source(split: Split, data_dir: str, start: int, end: int,
+                     custom_size: bool = False):
     """Deterministic record stream with the same carve-out/range semantics as
     the TFDS path: VALID = first 10k of the train stream, TRAIN skips them,
-    and [start, end) is this host's shard within the split."""
+    and [start, end) is this host's shard within the split. With
+    ``custom_size`` (a non-ImageNet dataset via ``split_examples``) the
+    VALID carve-out is disabled — the files hold exactly the split."""
     pattern = {
         Split.TRAIN: "train-*",
         Split.TRAIN_AND_VALID: "train-*",
@@ -196,7 +233,7 @@ def _tfrecord_source(split: Split, data_dir: str, start: int, end: int):
     # Files read in sorted order, sequentially, so absolute example indices
     # are stable across hosts (shuffling happens later, after sharding).
     ds = tf.data.TFRecordDataset(sorted(files))
-    offset = 10_000 if split is Split.TRAIN else 0
+    offset = 10_000 if (split is Split.TRAIN and not custom_size) else 0
     ds = ds.skip(offset + start).take(end - start)
     features = {
         "image/encoded": tf.io.FixedLenFeature([], tf.string),
@@ -205,10 +242,12 @@ def _tfrecord_source(split: Split, data_dir: str, start: int, end: int):
 
     def parse(record):
         ex = tf.io.parse_single_example(record, features)
-        # ImageNet TFRecords label in [1, 1000] → [0, 999].
+        # ImageNet TFRecords label in [1, 1000] → [0, 999]; custom datasets
+        # write 0-indexed labels.
+        shift = 0 if custom_size else 1
         return {
             "image_bytes": ex["image/encoded"],
-            "label": tf.cast(ex["image/class/label"], tf.int32) - 1,
+            "label": tf.cast(ex["image/class/label"], tf.int32) - shift,
         }
 
     return ds.map(parse, num_parallel_calls=tf.data.AUTOTUNE)
@@ -251,6 +290,9 @@ def load(
     seed: Optional[int] = None,
     process_index: Optional[int] = None,
     process_count: Optional[int] = None,
+    epoch_mode: bool = False,
+    strict_determinism: bool = False,
+    split_examples: Optional[int] = None,
 ) -> Generator[dict, None, None]:
     """Build the input generator. See module docstring.
 
@@ -263,6 +305,14 @@ def load(
     (input_pipeline.py:180-182, 218-222). The after-mix path re-quantizes
     the mixed images to uint8 for the augment ops, exactly like the
     reference's ``unbatch → augment_normalize → batch`` stage.
+
+    ``epoch_mode``: yield exactly one epoch (no ``.repeat()``) with
+    deterministic example order for the given ``seed`` — the building block
+    for preemption-safe resume (:func:`resumable_train_iterator`). With
+    ``strict_determinism`` the preprocess map also runs serially so the
+    stateful TF augmentation draws replay bit-exactly (slower; without it
+    the batch *composition* is deterministic but augment draws are not —
+    the same guarantee PyTorch-style loader resume gives).
     """
     total_batch = int(np.prod(batch_dims))
 
@@ -276,40 +326,61 @@ def load(
 
     pi = jax.process_index() if process_index is None else process_index
     pc = jax.process_count() if process_count is None else process_count
-    start, end = _host_shard_range(split, pi, pc)
+    start, end = _host_shard_range(split, pi, pc, split_examples)
 
     if source is not None:
         ds = _memory_source(source[0], source[1], start, end)
     elif data_dir is None:
         raise ValueError("need data_dir (TFDS/TFRecord) or source=(images, labels)")
+    elif split_examples is not None:
+        ds = _tfrecord_source(split, data_dir, start, end, custom_size=True)
     else:
         try:
             ds = _tfds_source(split, data_dir, start, end, is_training)
         except ImportError:
             ds = _tfrecord_source(split, data_dir, start, end)
 
+    if epoch_mode and is_training:
+        # Deterministic op-level seeds for this (pipeline, seed) build; the
+        # map stages below draw from stateful TF RNG ops whose seeds derive
+        # from this graph-level seed.
+        tf.random.set_seed(seed if seed is not None else 0)
+
     options = tf.data.Options()
     options.threading.private_threadpool_size = 48
     options.threading.max_intra_op_parallelism = 1
     options.experimental_optimization.map_parallelization = True
     if is_training:
-        options.deterministic = False
+        options.deterministic = bool(epoch_mode)
     ds = ds.with_options(options)
+
+    map_calls = 1 if (epoch_mode and strict_determinism) else tf.data.AUTOTUNE
 
     spec = None
     if is_training:
         from sav_tpu.data.augment_spec import parse_augment_spec
 
         spec = parse_augment_spec(augment_name)
-        if pc > 1:
+        if epoch_mode:
+            # Stable per-example ids key the stateless augmentation draws
+            # below; assigned on the sharded source so an id always names
+            # the same example.
+            ds = ds.enumerate().map(
+                lambda i, ex: dict(ex, _index=i), num_parallel_calls=tf.data.AUTOTUNE
+            )
+        if pc > 1 and not epoch_mode:
             # Multi-host training: cache the decoded-source shard on this
             # host before repeat/shuffle (input_pipeline.py:143-145) — each
-            # host re-reads only memory after epoch 1.
+            # host re-reads only memory after epoch 1. Skipped in epoch_mode:
+            # the resumable iterator rebuilds a fresh pipeline per epoch, so
+            # a cache would be filled once and thrown away.
             ds = ds.cache()
-        ds = ds.repeat()
+        if not epoch_mode:
+            ds = ds.repeat()
         ds = ds.shuffle(
             shuffle_buffer if shuffle_buffer is not None else 10 * total_batch,
             seed=seed,
+            reshuffle_each_iteration=not epoch_mode,
         )
     # Eval: no repeat; partial final batches are kept for flat batch_dims
     # (the trainer pads + masks them, so any mesh shape works) and dropped
@@ -339,14 +410,22 @@ def load(
 
     def preprocess(example):
         if is_training:
-            image = _train_preprocess(example["image_bytes"], image_size)
+            sseed = None
+            if epoch_mode:
+                base = tf.cast(seed if seed is not None else 0, tf.int64)
+                sseed = tf.stack(
+                    [base, tf.cast(example["_index"], tf.int64) * 2]
+                )
+            image = _train_preprocess(
+                example["image_bytes"], image_size, stateless_seed=sseed
+            )
             if not aug_after_mix:
                 image = _augment(image)
         else:
             image = _eval_preprocess(example["image_bytes"], image_size, eval_preproc)
         return {"images": image, "labels": tf.cast(example["label"], tf.int32)}
 
-    ds = ds.map(preprocess, num_parallel_calls=tf.data.AUTOTUNE)
+    ds = ds.map(preprocess, num_parallel_calls=map_calls)
     drop_remainder = is_training or len(batch_dims) > 1
     ds = ds.batch(total_batch, drop_remainder=drop_remainder)
 
@@ -355,9 +434,7 @@ def load(
 
         # Mixes run on 0..255 floats before normalization (commutes with the
         # per-channel affine normalize — see sav_tpu/data/mix.py).
-        ds = ds.map(
-            lambda b: apply_mixes(b, spec), num_parallel_calls=tf.data.AUTOTUNE
-        )
+        ds = ds.map(lambda b: apply_mixes(b, spec), num_parallel_calls=map_calls)
         if aug_after_mix:
             # Reference's augment-after-mix stage (input_pipeline.py:218-222):
             # re-quantize each mixed image to uint8, augment, rebatch.
@@ -369,7 +446,7 @@ def load(
 
             ds = (
                 ds.unbatch()
-                .map(requant_augment, num_parallel_calls=tf.data.AUTOTUNE)
+                .map(requant_augment, num_parallel_calls=map_calls)
                 .batch(total_batch, drop_remainder=True)
             )
 
@@ -416,6 +493,84 @@ def load(
 
     for batch in ds.as_numpy_iterator():
         yield _cast(dict(batch))
+
+
+def resumable_train_iterator(
+    split: Split,
+    *,
+    start_step: int = 0,
+    steps_per_epoch: Optional[int] = None,
+    seed: int = 0,
+    strict_determinism: bool = False,
+    **load_kwargs,
+) -> Generator[dict, None, None]:
+    """Preemption-safe train stream over per-epoch deterministic pipelines.
+
+    The tf.data equivalent of the SavRecord path's (seed, epoch)-replayable
+    iteration (sav_tpu/data/records.py): each epoch e is produced by a fresh
+    ``load(..., epoch_mode=True, seed=mix(seed, e))`` pipeline, so a run
+    restored at step S rebuilds epoch ``S // steps_per_epoch`` and skips
+    ``S % steps_per_epoch`` batches — every example is seen exactly the same
+    number of times as the uninterrupted run. The reference's train path
+    lost iterator position entirely on preemption (train.py never restored;
+    SURVEY.md §5 checkpoint/resume).
+
+    ``steps_per_epoch``: batches per epoch on this host; computed from the
+    split size when omitted.
+
+    ``strict_determinism``: also replay the random augmentation draws
+    bit-exactly (serial preprocess map — see :func:`load`).
+    """
+    kwargs = dict(load_kwargs)
+    kwargs.pop("epoch_mode", None)
+    kwargs.pop("seed", None)
+    if steps_per_epoch is None:
+        import jax
+
+        pi = kwargs.get("process_index")
+        pc = kwargs.get("process_count")
+        pi = jax.process_index() if pi is None else pi
+        pc = jax.process_count() if pc is None else pc
+        start, end = _host_shard_range(split, pi, pc, kwargs.get("split_examples"))
+        total_batch = int(np.prod(kwargs["batch_dims"]))
+        if "source" in kwargs and kwargs["source"] is not None:
+            end = min(end, len(kwargs["source"][0]))
+        steps_per_epoch = (end - start) // total_batch
+        if steps_per_epoch < 1:
+            # epoch_mode drops the remainder, so a shard smaller than one
+            # batch would yield nothing and the epoch loop would spin
+            # rebuilding pipelines forever.
+            raise ValueError(
+                f"host shard of {end - start} examples is smaller than the "
+                f"per-host batch ({total_batch}); shrink the batch or use "
+                "fewer hosts"
+            )
+
+    epoch = start_step // steps_per_epoch
+    skip = start_step % steps_per_epoch
+    while True:
+        it = load(
+            split,
+            is_training=True,
+            epoch_mode=True,
+            strict_determinism=strict_determinism,
+            # Golden-ratio mix keeps per-epoch seeds far apart while staying
+            # deterministic in (seed, epoch).
+            seed=(seed * 0x9E3779B1 + epoch) % (2**31),
+            **kwargs,
+        )
+        produced = 0
+        for batch in it:
+            if produced >= steps_per_epoch:
+                break  # keep epoch accounting exact even if load() yields more
+            if skip > 0:
+                skip -= 1
+                produced += 1
+                continue
+            produced += 1
+            yield batch
+        epoch += 1
+        skip = 0
 
 
 def _fake_batches(batch_dims, image_size, transpose, bfloat16):

@@ -5,9 +5,10 @@ Capability parity with the reference's attention family
 talking_heads.py:5-14), redesigned around the backend-dispatched functional
 cores in :mod:`sav_tpu.ops.attention` so every block can run on the fused
 Pallas TPU kernel (``backend='pallas'``) or the XLA reference path
-(``backend='xla'``). Talking-heads mixing happens on the logits, which breaks
-per-head independence inside the fused kernel — that variant always runs the
-XLA path (CaiT's self-attention trunk).
+(``backend='xla'``). Talking-heads mixing couples heads, so it gets its own
+fused kernel that keeps all heads of a batch element in one grid cell
+(:mod:`sav_tpu.ops.talking_heads` — CaiT's self-attention trunk); the XLA
+path remains the numerics reference and the long-sequence/dropout fallback.
 """
 
 from __future__ import annotations
@@ -27,16 +28,23 @@ Dtype = Any
 
 class TalkingHeadsBlock(nn.Module):
     """Learned head-mixing transform (orthogonal init), applied to attention
-    logits or probabilities. Reference: talking_heads.py:5-14."""
+    logits or probabilities. Reference: talking_heads.py:5-14.
+
+    Calling with ``None`` returns the raw ``[H, H]`` kernel instead of
+    applying it — the fused talking-heads kernel consumes the matrix
+    directly while keeping the identical ``{pre,post}_softmax/kernel``
+    checkpoint layout."""
 
     num_heads: int
     dtype: Dtype = jnp.float32
 
     @nn.compact
-    def __call__(self, x: jax.Array) -> jax.Array:
+    def __call__(self, x: Optional[jax.Array]) -> jax.Array:
         kernel = self.param(
             "kernel", nn.initializers.orthogonal(), (self.num_heads, self.num_heads)
         )
+        if x is None:
+            return kernel
         return jnp.einsum("hi,...hqk->...iqk", kernel.astype(x.dtype), x)
 
 
@@ -151,17 +159,52 @@ class AttentionBlock(nn.Module):
 
         has_attn_dropout = self.attn_dropout_rate > 0.0 and is_training
         if self.talking_heads:
-            # Head mixing couples heads pre-softmax → XLA path.
-            out = talking_heads_attention(
-                query,
-                key,
-                value,
-                num_heads=self.num_heads,
-                scale=scale,
-                attn_dropout_rate=self.attn_dropout_rate,
-                is_training=is_training,
-                dtype=self.dtype,
+            from sav_tpu.ops.talking_heads import fused_eligible
+
+            backend = self.backend or "auto"
+            fused_ok = (
+                not has_attn_dropout
+                and query.ndim == 4
+                and fused_eligible(self.num_heads, key.shape[1], head_ch)
             )
+            if backend == "pallas":
+                if has_attn_dropout:
+                    raise ValueError(
+                        "pallas talking-heads attention is deterministic-only "
+                        "(attention dropout runs on the XLA path)"
+                    )
+                use_fused = True  # kv-length guard raises inside the kernel
+            else:
+                use_fused = (
+                    backend == "auto"
+                    and fused_ok
+                    and jax.default_backend() == "tpu"
+                )
+            if use_fused:
+                from sav_tpu.ops.talking_heads import (
+                    flash_talking_heads_attention,
+                )
+
+                w_pre = TalkingHeadsBlock(
+                    num_heads=self.num_heads, dtype=self.dtype, name="pre_softmax"
+                )(None)
+                w_post = TalkingHeadsBlock(
+                    num_heads=self.num_heads, dtype=self.dtype, name="post_softmax"
+                )(None)
+                out = flash_talking_heads_attention(
+                    query, key, value, w_pre, w_post, scale=scale
+                )
+            else:
+                out = talking_heads_attention(
+                    query,
+                    key,
+                    value,
+                    num_heads=self.num_heads,
+                    scale=scale,
+                    attn_dropout_rate=self.attn_dropout_rate,
+                    is_training=is_training,
+                    dtype=self.dtype,
+                )
         else:
             dropout_rng = self.make_rng("dropout") if has_attn_dropout else None
             out = dot_product_attention(

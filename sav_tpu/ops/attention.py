@@ -14,7 +14,16 @@ reference. The Pallas path transposes to ``[B*H, L, D]`` internally.
   - ``'pallas'`` — fused Pallas TPU flash-attention kernel
                    (:mod:`sav_tpu.ops.flash_attention`). Deterministic only
                    (attention dropout falls back to XLA).
-  - ``'auto'``   — pallas on TPU when eligible, else xla.
+  - ``'auto'``   — measured-crossover dispatch on TPU (else xla). Benchmarked
+                   on v5e (PERF.md): at the model zoo's short sequences
+                   (197–785 tokens) XLA's batched-matmul attention beats
+                   every flash kernel — including the tuned stock one — by
+                   ~2×, because the L² logits easily fit HBM and the MXU
+                   stays busy; the fused kernel's win is *memory*: it keeps
+                   O(L²) out of HBM entirely, which is what long-context /
+                   ring-attention shapes need. ``auto`` therefore picks
+                   pallas only when the dense fp32 logits would be
+                   HBM-prohibitive and xla otherwise.
 """
 
 from __future__ import annotations
@@ -32,6 +41,17 @@ def _on_tpu() -> bool:
         return jax.default_backend() == "tpu"
     except RuntimeError:  # pragma: no cover - no backend at all
         return False
+
+
+# 'auto' flips to the fused kernel when materializing the [B, H, Lq, Lk]
+# fp32 logits (fwd + bwd residual ≈ 3 copies) would eat this much HBM —
+# beyond it the XLA path thrashes or OOMs while flash stays O(L·D).
+_AUTO_PALLAS_LOGITS_BYTES = 2 << 30
+
+
+def _dense_logits_bytes(query, key) -> int:
+    b, lq, h, _ = query.shape
+    return 3 * 4 * b * h * lq * key.shape[1]
 
 
 def xla_attention(
@@ -99,7 +119,10 @@ def dot_product_attention(
         and (bias is None or bias.ndim == 4)
     )
     if backend == "auto":
-        backend = "pallas" if (pallas_ok and _on_tpu()) else "xla"
+        big = pallas_ok and (
+            _dense_logits_bytes(query, key) > _AUTO_PALLAS_LOGITS_BYTES
+        )
+        backend = "pallas" if (big and _on_tpu()) else "xla"
     if backend == "pallas":
         if not pallas_ok:
             raise ValueError(

@@ -42,34 +42,45 @@ def _round_up(x: int, m: int) -> int:
     return ((x + m - 1) // m) * m
 
 
-def _online_softmax_step(s, v_ref, o_ref, m_scr, l_scr, acc_scr, ki,
-                         num_kv_blocks, lse_ref=None):
-    """Shared flash epilogue: fold this block's logits ``s`` into the running
-    (max, sum, acc) statistics; write the normalized output (and, when
-    ``lse_ref`` is given, the per-row logsumexp the blocked backward needs)
-    on the last kv block."""
-    m_prev = m_scr[:, 0:1]
-    l_prev = l_scr[:, 0:1]
+def _pick_block_b(bh: int, *, force_one: bool = False) -> int:
+    """Batch·head slices per grid cell. Grid-cell issue overhead on TPU is
+    ~µs-scale, so short-sequence shapes (few kv blocks per cell) want several
+    bh slices batched into one cell; 8 × block 256 stays well inside VMEM."""
+    if force_one:
+        return 1
+    for bb in (8, 4, 2):
+        if bh % bb == 0:
+            return bb
+    return 1
+
+
+def _online_softmax_step(s, v, o_ref, m_scr, l_scr, acc_scr, ki,
+                         num_kv_blocks, bi, lse_ref=None):
+    """Shared flash epilogue for one batch·head slice ``bi``: fold this
+    block's logits ``s`` into the running (max, sum, acc) statistics; write
+    the normalized output (and, when ``lse_ref`` is given, the per-row
+    logsumexp the blocked backward needs) on the last kv block."""
+    m_prev = m_scr[bi, :, 0:1]
+    l_prev = l_scr[bi, :, 0:1]
     m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
     alpha = jnp.exp(m_prev - m_new)
     p = jnp.exp(s - m_new)
     l_new = alpha * l_prev + jnp.sum(p, axis=-1, keepdims=True)
-    m_scr[...] = jnp.broadcast_to(m_new, m_scr.shape)
-    l_scr[...] = jnp.broadcast_to(l_new, l_scr.shape)
-    v = v_ref[0]
+    m_scr[bi] = jnp.broadcast_to(m_new, m_scr.shape[1:])
+    l_scr[bi] = jnp.broadcast_to(l_new, l_scr.shape[1:])
     pv = jax.lax.dot_general(
         p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
         preferred_element_type=jnp.float32,
     )
-    acc_scr[...] = acc_scr[...] * alpha + pv
+    acc_scr[bi] = acc_scr[bi] * alpha + pv
 
     @pl.when(ki == num_kv_blocks - 1)
     def _finalize():
-        o_ref[0] = (acc_scr[...] / l_scr[:, 0:1]).astype(o_ref.dtype)
+        o_ref[bi] = (acc_scr[bi] / l_scr[bi, :, 0:1]).astype(o_ref.dtype)
         if lse_ref is not None:
             # Combined logsumexp, broadcast across the lane tile so the
             # backward reads it with no relayout.
-            lse_ref[0] = m_scr[...] + jnp.log(l_scr[...])
+            lse_ref[bi] = m_scr[bi] + jnp.log(l_scr[bi])
 
 
 def _kernel(
@@ -81,11 +92,17 @@ def _kernel(
     with_lse: bool,
     scale: float,
     kv_len: int,
+    block_b: int,
     block_kv: int,
     num_kv_blocks: int,
 ):
     """Online-softmax flash kernel;
-    ``rest`` = ([bias_ref], o_ref, [lse_ref], m, l, acc)."""
+    ``rest`` = ([bias_ref], o_ref, [lse_ref], m, l, acc).
+
+    The leading grid axis carries ``block_b`` batch·head slices per cell
+    (unrolled loop below): TPU grid-cell issue overhead is ~µs-scale, so at
+    small sequence lengths a [B·H, 1, 1]-cell grid is overhead-bound — the
+    dominant cost at DeiT shapes, measured on v5e."""
     bias_ref = rest[0] if has_bias else None
     rest = rest[1 if has_bias else 0 :]
     if with_lse:
@@ -101,20 +118,21 @@ def _kernel(
         l_scr[...] = jnp.zeros_like(l_scr)
         acc_scr[...] = jnp.zeros_like(acc_scr)
 
-    q = q_ref[0]  # [block_q, d]
-    k = k_ref[0]  # [block_kv, d]
-    s = jax.lax.dot_general(
-        q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
-    )
-    s = s * scale
-    if has_bias:
-        s = s + bias_ref[0].astype(jnp.float32)
-    if kv_len % block_kv != 0:
-        col = ki * block_kv + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
-        s = jnp.where(col < kv_len, s, _NEG_INF)
+    for bi in range(block_b):
+        q = q_ref[bi]  # [block_q, d]
+        k = k_ref[bi]  # [block_kv, d]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        s = s * scale
+        if has_bias:
+            s = s + bias_ref[bi].astype(jnp.float32)
+        if kv_len % block_kv != 0:
+            col = ki * block_kv + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+            s = jnp.where(col < kv_len, s, _NEG_INF)
 
-    _online_softmax_step(s, v_ref, o_ref, m_scr, l_scr, acc_scr, ki,
-                         num_kv_blocks, lse_ref=lse_ref)
+        _online_softmax_step(s, v_ref[bi], o_ref, m_scr, l_scr, acc_scr, ki,
+                             num_kv_blocks, bi, lse_ref=lse_ref)
 
 
 def _flash_forward(
@@ -157,32 +175,36 @@ def _flash_forward(
 
     qf, kf, vf = pad3(qf, q_len_p), pad3(kf, kv_len_p), pad3(vf, kv_len_p)
 
-    num_q_blocks = q_len_p // block_q
-    num_kv_blocks = kv_len_p // block_kv
-    grid = (batch * heads, num_q_blocks, num_kv_blocks)
-
-    in_specs = [
-        pl.BlockSpec((1, block_q, dim_p), lambda b, i, j: (b, i, 0)),
-        pl.BlockSpec((1, block_kv, dim_p), lambda b, i, j: (b, j, 0)),
-        pl.BlockSpec((1, block_kv, dim_p), lambda b, i, j: (b, j, 0)),
-    ]
-    args = [qf, kf, vf]
+    shared_bias = False
     if bias is not None:
         bias = jnp.broadcast_to(bias, bias.shape[:-2] + (q_len, kv_len))
         bb, bh = bias.shape[0], bias.shape[1]
         if (bb, bh) not in ((batch, heads), (1, 1)):
             bias = jnp.broadcast_to(bias, (batch, heads) + bias.shape[-2:])
             bb, bh = batch, heads
-        biasf = bias.reshape(bb * bh, q_len, kv_len)
+        shared_bias = bb * bh == 1
+
+    block_b = _pick_block_b(batch * heads, force_one=shared_bias)
+    num_q_blocks = q_len_p // block_q
+    num_kv_blocks = kv_len_p // block_kv
+    grid = (batch * heads // block_b, num_q_blocks, num_kv_blocks)
+
+    in_specs = [
+        pl.BlockSpec((block_b, block_q, dim_p), lambda b, i, j: (b, i, 0)),
+        pl.BlockSpec((block_b, block_kv, dim_p), lambda b, i, j: (b, j, 0)),
+        pl.BlockSpec((block_b, block_kv, dim_p), lambda b, i, j: (b, j, 0)),
+    ]
+    args = [qf, kf, vf]
+    if bias is not None:
+        biasf = bias.reshape(-1, q_len, kv_len)
         biasf = jnp.pad(
             biasf, ((0, 0), (0, q_len_p - q_len), (0, kv_len_p - kv_len))
         )
-        shared = bb * bh == 1
-        if shared:
+        if shared_bias:
             bias_index = lambda b, i, j: (0, i, j)
         else:
             bias_index = lambda b, i, j: (b, i, j)
-        in_specs.append(pl.BlockSpec((1, block_q, block_kv), bias_index))
+        in_specs.append(pl.BlockSpec((block_b, block_q, block_kv), bias_index))
         args.append(biasf)
 
     kernel = functools.partial(
@@ -191,15 +213,18 @@ def _flash_forward(
         with_lse=with_lse,
         scale=scale,
         kv_len=kv_len,
+        block_b=block_b,
         block_kv=block_kv,
         num_kv_blocks=num_kv_blocks,
     )
 
-    out_specs = [pl.BlockSpec((1, block_q, dim_p), lambda b, i, j: (b, i, 0))]
+    out_specs = [
+        pl.BlockSpec((block_b, block_q, dim_p), lambda b, i, j: (b, i, 0))
+    ]
     out_shape = [jax.ShapeDtypeStruct((batch * heads, q_len_p, dim_p), q.dtype)]
     if with_lse:
         out_specs.append(
-            pl.BlockSpec((1, block_q, 128), lambda b, i, j: (b, i, 0))
+            pl.BlockSpec((block_b, block_q, 128), lambda b, i, j: (b, i, 0))
         )
         out_shape.append(
             jax.ShapeDtypeStruct((batch * heads, q_len_p, 128), jnp.float32)
@@ -212,9 +237,9 @@ def _flash_forward(
         out_specs=out_specs,
         out_shape=out_shape,
         scratch_shapes=[
-            pltpu.VMEM((block_q, 128), jnp.float32),
-            pltpu.VMEM((block_q, 128), jnp.float32),
-            pltpu.VMEM((block_q, dim_p), jnp.float32),
+            pltpu.VMEM((block_b, block_q, 128), jnp.float32),
+            pltpu.VMEM((block_b, block_q, 128), jnp.float32),
+            pltpu.VMEM((block_b, block_q, dim_p), jnp.float32),
         ],
         interpret=interpret,
     )(*args)
@@ -253,48 +278,52 @@ def _lanes(x: jax.Array, n: int) -> jax.Array:
 
 def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
                    dq_acc, *, scale: float, q_len: int, kv_len: int,
-                   block_q: int, block_kv: int, num_kv_blocks: int):
+                   block_b: int, block_q: int, block_kv: int,
+                   num_kv_blocks: int):
     ki = pl.program_id(2)
 
     @pl.when(ki == 0)
     def _init():
         dq_acc[...] = jnp.zeros_like(dq_acc)
 
-    q, k, v, do = q_ref[0], k_ref[0], v_ref[0], do_ref[0]
-    s = jax.lax.dot_general(
-        q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
-    ) * scale
-    p = jnp.exp(s - _lanes(lse_ref[0], s.shape[1]))
-    if kv_len % block_kv != 0:
-        col = ki * block_kv + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
-        p = jnp.where(col < kv_len, p, 0.0)
-    if q_len % block_q != 0:
-        # Padded (zero) q rows carry a finite lse ≈ log(kv_len), so p is
-        # finite garbage, not NaN; their dq rows are sliced off outside.
-        # Zero them anyway so the padded rows cost nothing downstream and
-        # the invariant "p == 0 outside the real block" holds in both
-        # backward kernels.
-        row = pl.program_id(1) * block_q + jax.lax.broadcasted_iota(
-            jnp.int32, s.shape, 0
+    for bi in range(block_b):
+        q, k, v, do = q_ref[bi], k_ref[bi], v_ref[bi], do_ref[bi]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        ) * scale
+        p = jnp.exp(s - _lanes(lse_ref[bi], s.shape[1]))
+        if kv_len % block_kv != 0:
+            col = ki * block_kv + jax.lax.broadcasted_iota(
+                jnp.int32, s.shape, 1
+            )
+            p = jnp.where(col < kv_len, p, 0.0)
+        if q_len % block_q != 0:
+            # Padded (zero) q rows carry a finite lse ≈ log(kv_len), so p is
+            # finite garbage, not NaN; their dq rows are sliced off outside.
+            # Zero them anyway so the padded rows cost nothing downstream and
+            # the invariant "p == 0 outside the real block" holds in both
+            # backward kernels.
+            row = pl.program_id(1) * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, s.shape, 0
+            )
+            p = jnp.where(row < q_len, p, 0.0)
+        dp = jax.lax.dot_general(
+            do, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
         )
-        p = jnp.where(row < q_len, p, 0.0)
-    dp = jax.lax.dot_general(
-        do, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
-    )
-    ds = p * (dp - _lanes(delta_ref[0], s.shape[1]))
-    dq_acc[...] += jax.lax.dot_general(
-        ds.astype(k.dtype), k, (((1,), (0,)), ((), ())),
-        preferred_element_type=jnp.float32,
-    ) * scale
+        ds = p * (dp - _lanes(delta_ref[bi], s.shape[1]))
+        dq_acc[bi] += jax.lax.dot_general(
+            ds.astype(k.dtype), k, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ) * scale
 
     @pl.when(ki == num_kv_blocks - 1)
     def _write():
-        dq_ref[0] = dq_acc[...].astype(dq_ref.dtype)
+        dq_ref[...] = dq_acc[...].astype(dq_ref.dtype)
 
 
 def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref,
                     dv_ref, dk_acc, dv_acc, *, scale: float, q_len: int,
-                    block_q: int, num_q_blocks: int):
+                    block_b: int, block_q: int, num_q_blocks: int):
     qi = pl.program_id(2)
 
     @pl.when(qi == 0)
@@ -302,32 +331,35 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref,
         dk_acc[...] = jnp.zeros_like(dk_acc)
         dv_acc[...] = jnp.zeros_like(dv_acc)
 
-    q, k, v, do = q_ref[0], k_ref[0], v_ref[0], do_ref[0]
-    s = jax.lax.dot_general(
-        q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
-    ) * scale  # [block_q, block_kv]
-    p = jnp.exp(s - _lanes(lse_ref[0], s.shape[1]))
-    if q_len % block_q != 0:
-        # Padded q rows must not contribute to the dk/dv sums.
-        row = qi * block_q + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
-        p = jnp.where(row < q_len, p, 0.0)
-    dv_acc[...] += jax.lax.dot_general(
-        p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
-        preferred_element_type=jnp.float32,
-    )
-    dp = jax.lax.dot_general(
-        do, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
-    )
-    ds = p * (dp - _lanes(delta_ref[0], s.shape[1]))
-    dk_acc[...] += jax.lax.dot_general(
-        ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
-        preferred_element_type=jnp.float32,
-    ) * scale
+    for bi in range(block_b):
+        q, k, v, do = q_ref[bi], k_ref[bi], v_ref[bi], do_ref[bi]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        ) * scale  # [block_q, block_kv]
+        p = jnp.exp(s - _lanes(lse_ref[bi], s.shape[1]))
+        if q_len % block_q != 0:
+            # Padded q rows must not contribute to the dk/dv sums.
+            row = qi * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, s.shape, 0
+            )
+            p = jnp.where(row < q_len, p, 0.0)
+        dv_acc[bi] += jax.lax.dot_general(
+            p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        dp = jax.lax.dot_general(
+            do, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        ds = p * (dp - _lanes(delta_ref[bi], s.shape[1]))
+        dk_acc[bi] += jax.lax.dot_general(
+            ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ) * scale
 
     @pl.when(qi == num_q_blocks - 1)
     def _write():
-        dk_ref[0] = dk_acc[...].astype(dk_ref.dtype)
-        dv_ref[0] = dv_acc[...].astype(dv_ref.dtype)
+        dk_ref[...] = dk_acc[...].astype(dk_ref.dtype)
+        dv_ref[...] = dv_acc[...].astype(dv_ref.dtype)
 
 
 def _flash_backward_pallas(q, k, v, out, lse, g, scale, block_q, block_kv,
@@ -369,10 +401,11 @@ def _flash_backward_pallas(q, k, v, out, lse, g, scale, block_q, block_kv,
     num_q_blocks = q_len_p // block_q
     num_kv_blocks = kv_len_p // block_kv
     bh = batch * heads
+    block_b = _pick_block_b(bh)
 
-    qspec = pl.BlockSpec((1, block_q, dim_p), lambda b, i, j: (b, i, 0))
-    kspec = pl.BlockSpec((1, block_kv, dim_p), lambda b, i, j: (b, j, 0))
-    rowq = pl.BlockSpec((1, block_q, 128), lambda b, i, j: (b, i, 0))
+    qspec = pl.BlockSpec((block_b, block_q, dim_p), lambda b, i, j: (b, i, 0))
+    kspec = pl.BlockSpec((block_b, block_kv, dim_p), lambda b, i, j: (b, j, 0))
+    rowq = pl.BlockSpec((block_b, block_q, 128), lambda b, i, j: (b, i, 0))
 
     dq = pl.pallas_call(
         functools.partial(
@@ -380,32 +413,34 @@ def _flash_backward_pallas(q, k, v, out, lse, g, scale, block_q, block_kv,
             scale=scale,
             q_len=q_len,
             kv_len=kv_len,
+            block_b=block_b,
             block_q=block_q,
             block_kv=block_kv,
             num_kv_blocks=num_kv_blocks,
         ),
-        grid=(bh, num_q_blocks, num_kv_blocks),
+        grid=(bh // block_b, num_q_blocks, num_kv_blocks),
         in_specs=[qspec, kspec, kspec, qspec, rowq, rowq],
         out_specs=qspec,
         out_shape=jax.ShapeDtypeStruct((bh, q_len_p, dim_p), q.dtype),
-        scratch_shapes=[pltpu.VMEM((block_q, dim_p), jnp.float32)],
+        scratch_shapes=[pltpu.VMEM((block_b, block_q, dim_p), jnp.float32)],
         interpret=interpret,
     )(qf, kf, vf, dof, lse, delta)
 
     # q-innermost grid for dk/dv: block index 1 is the kv block, index 2
     # sweeps q blocks into the accumulators.
-    qspec2 = pl.BlockSpec((1, block_q, dim_p), lambda b, j, i: (b, i, 0))
-    kspec2 = pl.BlockSpec((1, block_kv, dim_p), lambda b, j, i: (b, j, 0))
-    rowq2 = pl.BlockSpec((1, block_q, 128), lambda b, j, i: (b, i, 0))
+    qspec2 = pl.BlockSpec((block_b, block_q, dim_p), lambda b, j, i: (b, i, 0))
+    kspec2 = pl.BlockSpec((block_b, block_kv, dim_p), lambda b, j, i: (b, j, 0))
+    rowq2 = pl.BlockSpec((block_b, block_q, 128), lambda b, j, i: (b, i, 0))
     dk, dv = pl.pallas_call(
         functools.partial(
             _bwd_dkv_kernel,
             scale=scale,
             q_len=q_len,
+            block_b=block_b,
             block_q=block_q,
             num_q_blocks=num_q_blocks,
         ),
-        grid=(bh, num_kv_blocks, num_q_blocks),
+        grid=(bh // block_b, num_kv_blocks, num_q_blocks),
         in_specs=[qspec2, kspec2, kspec2, qspec2, rowq2, rowq2],
         out_specs=[kspec2, kspec2],
         out_shape=[
@@ -413,8 +448,8 @@ def _flash_backward_pallas(q, k, v, out, lse, g, scale, block_q, block_kv,
             jax.ShapeDtypeStruct((bh, kv_len_p, dim_p), v.dtype),
         ],
         scratch_shapes=[
-            pltpu.VMEM((block_kv, dim_p), jnp.float32),
-            pltpu.VMEM((block_kv, dim_p), jnp.float32),
+            pltpu.VMEM((block_b, block_kv, dim_p), jnp.float32),
+            pltpu.VMEM((block_b, block_kv, dim_p), jnp.float32),
         ],
         interpret=interpret,
     )(qf, kf, vf, dof, lse, delta)
@@ -594,8 +629,8 @@ def _rel_kernel(
         kcol = ki * block_kv + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
         s = jnp.where(kcol < kv_len, s, _NEG_INF)
 
-    _online_softmax_step(s, v_ref, o_ref, m_scr, l_scr, acc_scr, ki,
-                         num_kv_blocks)
+    _online_softmax_step(s, v_ref[0], o_ref, m_scr, l_scr, acc_scr, ki,
+                         num_kv_blocks, 0)
 
 
 def _rel_forward(q, k, v, rw_abs, rh_abs, height, width, scale, block_q,
@@ -660,9 +695,9 @@ def _rel_forward(q, k, v, rw_abs, rh_abs, height, width, scale, block_q,
         out_specs=pl.BlockSpec((1, block_q, dim_p), lambda b, i, j: (b, i, 0)),
         out_shape=jax.ShapeDtypeStruct((batch * heads, q_len_p, dim_p), q.dtype),
         scratch_shapes=[
-            pltpu.VMEM((block_q, 128), jnp.float32),
-            pltpu.VMEM((block_q, 128), jnp.float32),
-            pltpu.VMEM((block_q, dim_p), jnp.float32),
+            pltpu.VMEM((1, block_q, 128), jnp.float32),
+            pltpu.VMEM((1, block_q, 128), jnp.float32),
+            pltpu.VMEM((1, block_q, dim_p), jnp.float32),
         ],
         interpret=interpret,
     )(qf, kf, vf, rwf, rhf)

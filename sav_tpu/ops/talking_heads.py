@@ -1,0 +1,233 @@
+"""Fused talking-heads attention (CaiT trunk) — Pallas TPU kernel.
+
+Talking-heads attention (reference: /root/reference/models/layers/attentions/
+talking_heads.py:5-14 applied at attention.py:44-52) mixes attention *logits*
+across heads before the softmax and mixes the *probabilities* after it:
+
+    s'_i = Σ_h W_pre[h, i] · s_h        (pre-softmax head mix)
+    p_i  = softmax(s'_i)
+    p'_i = Σ_h W_post[h, i] · p_h       (post-softmax head mix)
+    out_i = p'_i · V_i
+
+The head coupling breaks the per-head independence the generic flash kernel
+relies on, so this kernel keeps **all heads of one batch element in a single
+grid cell** and mixes them in VMEM. CaiT's talking-heads trunk runs at short
+sequence lengths by design (196 tokens for the named CaiT configs), so the
+whole K/V fits one block and the softmax is exact row-wise — no online
+accumulation needed. The ``[B, H, L, L]`` logits therefore never exist in
+HBM on the forward pass; the backward is an XLA flash-style recompute (the
+head mixing makes the blocked backward a 4-way coupled system; dense
+recompute at ≤1k tokens is cheap and keeps numerics identical to autodiff).
+
+The ``[H, H]`` mixing matrices ride in SMEM and are read as scalars.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+_NEG_INF = float("-inf")
+
+# Soft cap on the kernel's VMEM working set. The dominant terms per grid
+# cell are the per-head logits+probs tiles (2 · H · block_q · kv_len_p · 4 B
+# live at once) plus the whole K/V (2 · H · kv_len_p · dim_p · 2 B); the
+# budget leaves headroom under the ~16 MB/core VMEM.
+VMEM_BUDGET_BYTES = 10 << 20
+_DEFAULT_BLOCK_Q = 256
+
+
+def _round_up(x: int, m: int) -> int:
+    return ((x + m - 1) // m) * m
+
+
+def fused_eligible(heads: int, kv_len: int, dim: int,
+                   block_q: int = _DEFAULT_BLOCK_Q) -> bool:
+    """Whether the all-heads-in-cell kernel fits the VMEM budget.
+
+    Used by the ``'auto'`` dispatch so ineligible shapes (many heads ×
+    long kv) fall back to XLA instead of failing Mosaic VMEM allocation."""
+    kv_len_p = _round_up(kv_len, 128)
+    dim_p = _round_up(dim, 128)
+    block_q = min(block_q, _round_up(kv_len, 16))
+    logits = 2 * heads * block_q * kv_len_p * 4
+    kv = 2 * heads * kv_len_p * dim_p * 2
+    qo = 2 * heads * block_q * dim_p * 2
+    return logits + kv + qo <= VMEM_BUDGET_BYTES
+
+
+def _th_kernel(q_ref, k_ref, v_ref, wpre_ref, wpost_ref, o_ref, *,
+               heads: int, scale: float, kv_len: int, kv_len_p: int):
+    """One grid cell = all heads of one batch element × one q block."""
+    logits = []
+    for h in range(heads):
+        s = jax.lax.dot_general(
+            q_ref[0, h], k_ref[0, h], (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        logits.append(s * scale)
+
+    col = jax.lax.broadcasted_iota(jnp.int32, logits[0].shape, 1)
+    probs = []
+    for i in range(heads):
+        # Pre-softmax mix. Padded kv columns hold Σ_h w·0 = 0 garbage —
+        # masked to −inf *after* the mix, exactly where the reference's
+        # dense mask would sit.
+        mixed = logits[0] * wpre_ref[0, i]
+        for h in range(1, heads):
+            mixed += logits[h] * wpre_ref[h, i]
+        if kv_len != kv_len_p:
+            mixed = jnp.where(col < kv_len, mixed, _NEG_INF)
+        m = jnp.max(mixed, axis=-1, keepdims=True)
+        p = jnp.exp(mixed - m)
+        probs.append(p / jnp.sum(p, axis=-1, keepdims=True))
+
+    for i in range(heads):
+        post = probs[0] * wpost_ref[0, i]
+        for h in range(1, heads):
+            post += probs[h] * wpost_ref[h, i]
+        v = v_ref[0, i]
+        o_ref[0, i] = jax.lax.dot_general(
+            post.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ).astype(o_ref.dtype)
+
+
+def _th_forward(q, k, v, w_pre, w_post, scale, block_q, interpret):
+    """q/k/v ``[B, L, H, D]``; w_pre/w_post ``[H, H]`` float32."""
+    batch, q_len, heads, dim = q.shape
+    kv_len = k.shape[1]
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+
+    def to_bhld(x):
+        return jnp.transpose(x, (0, 2, 1, 3))  # [B, H, L, D]
+
+    dim_p = _round_up(dim, 128)
+    block_q = min(block_q, _round_up(q_len, 16))
+    q_len_p = _round_up(q_len, block_q)
+    kv_len_p = _round_up(kv_len, 128)
+
+    def pad4(x, lp):
+        return jnp.pad(
+            x, ((0, 0), (0, 0), (0, lp - x.shape[2]), (0, dim_p - x.shape[3]))
+        )
+
+    qf = pad4(to_bhld(q), q_len_p)
+    kf = pad4(to_bhld(k), kv_len_p)
+    vf = pad4(to_bhld(v), kv_len_p)
+
+    kernel = functools.partial(
+        _th_kernel,
+        heads=heads,
+        scale=scale,
+        kv_len=kv_len,
+        kv_len_p=kv_len_p,
+    )
+    out = pl.pallas_call(
+        kernel,
+        grid=(batch, q_len_p // block_q),
+        in_specs=[
+            pl.BlockSpec(
+                (1, heads, block_q, dim_p), lambda b, i: (b, 0, i, 0)
+            ),
+            pl.BlockSpec(
+                (1, heads, kv_len_p, dim_p), lambda b, i: (b, 0, 0, 0)
+            ),
+            pl.BlockSpec(
+                (1, heads, kv_len_p, dim_p), lambda b, i: (b, 0, 0, 0)
+            ),
+            pl.BlockSpec(memory_space=pltpu.SMEM),
+            pl.BlockSpec(memory_space=pltpu.SMEM),
+        ],
+        out_specs=pl.BlockSpec(
+            (1, heads, block_q, dim_p), lambda b, i: (b, 0, i, 0)
+        ),
+        out_shape=jax.ShapeDtypeStruct(
+            (batch, heads, q_len_p, dim_p), q.dtype
+        ),
+        interpret=interpret,
+    )(qf, kf, vf, w_pre.astype(jnp.float32), w_post.astype(jnp.float32))
+    out = out[:, :, :q_len, :dim]
+    return jnp.transpose(out, (0, 2, 1, 3))
+
+
+def _th_dense_reference(q, k, v, w_pre, w_post, scale):
+    """Dense XLA talking-heads attention (backward recompute + numerics
+    cross-check). Mirrors sav_tpu.models.layers.attention.talking_heads_attention."""
+    s = jnp.einsum(
+        "bqhd,bkhd->bhqk", q * jnp.asarray(scale, q.dtype), k,
+        preferred_element_type=jnp.float32,
+    )
+    s = jnp.einsum("hi,bhqk->biqk", w_pre.astype(jnp.float32), s)
+    p = jax.nn.softmax(s, axis=-1)
+    p = jnp.einsum("hi,bhqk->biqk", w_post.astype(jnp.float32), p)
+    return jnp.einsum(
+        "bhqk,bkhd->bqhd", p.astype(v.dtype), v,
+        preferred_element_type=jnp.float32,
+    ).astype(q.dtype)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7))
+def _th(q, k, v, w_pre, w_post, scale, block_q, interpret):
+    return _th_forward(q, k, v, w_pre, w_post, scale, block_q, interpret)
+
+
+def _th_fwd(q, k, v, w_pre, w_post, scale, block_q, interpret):
+    out = _th_forward(q, k, v, w_pre, w_post, scale, block_q, interpret)
+    return out, (q, k, v, w_pre, w_post)
+
+
+def _th_bwd(scale, block_q, interpret, residuals, g):
+    q, k, v, w_pre, w_post = residuals
+    _, vjp = jax.vjp(
+        lambda q, k, v, wp, wq: _th_dense_reference(q, k, v, wp, wq, scale),
+        q, k, v, w_pre, w_post,
+    )
+    return vjp(g)
+
+
+_th.defvjp(_th_fwd, _th_bwd)
+
+
+def flash_talking_heads_attention(
+    query: jax.Array,
+    key: jax.Array,
+    value: jax.Array,
+    w_pre: jax.Array,
+    w_post: jax.Array,
+    *,
+    scale: Optional[float] = None,
+    block_q: int = 256,
+    interpret: Optional[bool] = None,
+) -> jax.Array:
+    """Fused talking-heads attention. See module docstring.
+
+    Args:
+      query/key/value: ``[B, L, H, D]``.
+      w_pre / w_post: ``[H, H]`` learned head-mixing matrices
+        (``mixed_i = Σ_h W[h, i] · head_h``, the reference's einsum
+        ``'h i, b h ... -> b i ...'``).
+      scale: logit scale, default ``D ** -0.5``.
+
+    Raises:
+      ValueError: shape beyond the VMEM budget (whole-K/V-in-VMEM design;
+        talking-heads models run short trunks — use the XLA path otherwise).
+    """
+    if query.ndim != 4:
+        raise ValueError(f"expected [B, L, H, D] inputs, got {query.shape}")
+    _, kv_len, heads, dim = key.shape
+    if not fused_eligible(heads, kv_len, dim, block_q):
+        raise ValueError(
+            f"fused talking-heads holds all heads' K/V and logits in VMEM; "
+            f"heads={heads}, kv_len={kv_len}, dim={dim} exceeds the "
+            f"{VMEM_BUDGET_BYTES >> 20} MB budget — use the XLA path"
+        )
+    if scale is None:
+        scale = query.shape[-1] ** -0.5
+    return _th(query, key, value, w_pre, w_post, float(scale), block_q, interpret)

@@ -23,11 +23,14 @@ def warmup_cosine_schedule(
     num_epochs: int,
     end_lr: float = 1e-5,
 ) -> optax.Schedule:
+    warmup_steps = max(1, warmup_epochs * steps_per_epoch)
     return optax.warmup_cosine_decay_schedule(
         init_value=0.0,
         peak_value=learning_rate,
-        warmup_steps=max(1, warmup_epochs * steps_per_epoch),
-        decay_steps=max(2, num_epochs * steps_per_epoch),
+        warmup_steps=warmup_steps,
+        # optax requires decay_steps > warmup_steps; short runs (warmup
+        # longer than the whole schedule) degenerate to warmup-only.
+        decay_steps=max(warmup_steps + 1, num_epochs * steps_per_epoch),
         end_value=end_lr,
     )
 

@@ -311,6 +311,12 @@ class Trainer:
 
     # ------------------------------------------------------------------ loop
 
+    @property
+    def _peak_flops(self) -> Optional[float]:
+        from sav_tpu.utils.flops import per_chip_peak_flops
+
+        return per_chip_peak_flops()
+
     def train_step(self, state: TrainState, batch: dict, rng: jax.Array):
         return self._train_step(state, self.shard_batch(batch), rng)
 
@@ -388,6 +394,13 @@ class Trainer:
         state = state if state is not None else self.restore_or_init()
         rng = jax.random.PRNGKey(cfg.seed + 1)
         history: list[dict] = []
+        # When MFU can be reported (known chip peak), the step is compiled
+        # ahead-of-time ONCE and the loop calls the compiled executable —
+        # cost analysis comes from the same compilation, not a second one
+        # (AOT .compile() does not populate the jit dispatch cache).
+        step_flops: Optional[float] = None
+        compiled_step = None
+        peak_flops = self._peak_flops
         start_step = int(jax.device_get(state.step))
         t_last = time.time()
         last_logged_step = start_step
@@ -412,7 +425,19 @@ class Trainer:
                         jax.block_until_ready(state)
                         profiler.stop_trace()
                         profiling = False
-                state, metrics = self.train_step(state, batch, rng)
+                sharded = self.shard_batch(batch)
+                if peak_flops and compiled_step is None:
+                    from sav_tpu.utils.flops import compiled_flops
+
+                    compiled_step = self._train_step.lower(
+                        state, sharded, rng
+                    ).compile()
+                    step_flops = compiled_flops(compiled_step)
+                    # Don't let compile time pollute the first throughput
+                    # and MFU window.
+                    t_last = time.time()
+                step_fn = compiled_step if compiled_step is not None else self._train_step
+                state, metrics = step_fn(state, sharded, rng)
                 if cfg.debug_nans:
                     assert_all_finite(metrics, f"metrics at step {step + 1}")
                 if (step + 1) % cfg.log_every_steps == 0 or step + 1 == num_steps:
@@ -423,6 +448,12 @@ class Trainer:
                     m["images_per_sec"] = (
                         cfg.global_batch_size * steps_since / max(now - t_last, 1e-9)
                     )
+                    if step_flops and peak_flops:
+                        # Model-FLOPs utilization, per chip: cost_analysis
+                        # FLOPs are per-device (sav_tpu/utils/flops.py) —
+                        # the north star in its own unit (BASELINE.md).
+                        step_s = max(now - t_last, 1e-9) / max(steps_since, 1)
+                        m["mfu"] = step_flops / step_s / peak_flops
                     t_last = now
                     last_logged_step = step + 1
                     history.append(m)

@@ -1,0 +1,45 @@
+"""FLOPs / MFU helpers shared by the trainer and bench.py.
+
+XLA's ``compiled.cost_analysis()`` reports the **per-device** FLOPs of the
+SPMD-partitioned executable (verified on an 8-way sharded program: exactly
+1/8 of the single-device count). MFU is therefore computed per chip:
+
+    mfu = per_device_flops / step_time / per_chip_peak
+
+which is correct for any mesh size without knowing the global batch.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+
+# Peak dense bf16 FLOP/s per chip, matched on substrings of
+# ``jax.Device.device_kind``.
+PEAK_FLOPS_PER_CHIP = {
+    "v5 lite": 197e12,
+    "v5e": 197e12,
+    "v5p": 459e12,
+    "v4": 275e12,
+    "v6": 918e12,
+}
+
+
+def per_chip_peak_flops(devices=None) -> Optional[float]:
+    """Peak bf16 FLOP/s of one chip (None if the device kind is unknown)."""
+    devices = jax.devices() if devices is None else devices
+    kind = getattr(devices[0], "device_kind", "").lower()
+    for key, peak in PEAK_FLOPS_PER_CHIP.items():
+        if key in kind:
+            return peak
+    return None
+
+
+def compiled_flops(compiled) -> float:
+    """Per-device FLOPs from a compiled executable (0.0 if unavailable)."""
+    try:
+        cost = compiled.cost_analysis()
+        return float(cost.get("flops", 0.0)) if cost else 0.0
+    except Exception:  # pragma: no cover - backend-dependent
+        return 0.0

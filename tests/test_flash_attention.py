@@ -166,3 +166,77 @@ def test_relative_logits_2d_offsets():
                     np.testing.assert_allclose(
                         out[0, 0, x, y, xx, yy], expected, rtol=1e-4
                     )
+
+
+# ------------------------------------------------- talking-heads (CaiT)
+
+
+@pytest.mark.parametrize("lq,lk,h,d", [(196, 196, 4, 48), (50, 50, 2, 32)])
+def test_talking_heads_fused_matches_xla(lq, lk, h, d):
+    from sav_tpu.ops.talking_heads import (
+        _th_dense_reference,
+        flash_talking_heads_attention,
+    )
+
+    q, k, v = _qkv(lq=lq, lk=lk, h=h, d=d)
+    ks = jax.random.split(jax.random.PRNGKey(5), 2)
+    w_pre = jax.nn.initializers.orthogonal()(ks[0], (h, h))
+    w_post = jax.nn.initializers.orthogonal()(ks[1], (h, h))
+    ref = _th_dense_reference(q, k, v, w_pre, w_post, d ** -0.5)
+    out = flash_talking_heads_attention(q, k, v, w_pre, w_post)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=5e-5, rtol=5e-5)
+
+
+def test_talking_heads_fused_gradients_match_dense():
+    from sav_tpu.ops.talking_heads import (
+        _th_dense_reference,
+        flash_talking_heads_attention,
+    )
+
+    q, k, v = _qkv(lq=40, lk=40, h=2, d=16)
+    ks = jax.random.split(jax.random.PRNGKey(6), 2)
+    w_pre = jax.nn.initializers.orthogonal()(ks[0], (2, 2))
+    w_post = jax.nn.initializers.orthogonal()(ks[1], (2, 2))
+
+    def loss(fn):
+        return lambda *a: jnp.sum(jnp.square(fn(*a)))
+
+    gf = jax.grad(loss(lambda *a: flash_talking_heads_attention(*a)), argnums=(0, 1, 2, 3, 4))(
+        q, k, v, w_pre, w_post
+    )
+    gx = jax.grad(loss(lambda *a: _th_dense_reference(*a, 16 ** -0.5)), argnums=(0, 1, 2, 3, 4))(
+        q, k, v, w_pre, w_post
+    )
+    for a, b in zip(gf, gx):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=5e-5, rtol=5e-4)
+
+
+def test_talking_heads_fused_rejects_over_budget_shapes():
+    from sav_tpu.ops.talking_heads import (
+        flash_talking_heads_attention,
+        fused_eligible,
+    )
+
+    # Many heads × long kv blows the VMEM working set (the CaiT-M-at-high-res
+    # class of shapes) — must raise, and the auto gate must say ineligible.
+    assert not fused_eligible(heads=16, kv_len=2026, dim=64)
+    assert fused_eligible(heads=4, kv_len=196, dim=48)  # CaiT-XXS24 trunk
+    q, k, v = _qkv(lq=8, lk=2026, h=16, d=64)
+    w = jnp.eye(16)
+    with pytest.raises(ValueError, match="VMEM"):
+        flash_talking_heads_attention(q, k, v, w, w)
+
+
+def test_talking_heads_block_kernel_accessor():
+    """TalkingHeadsBlock(None) returns the kernel with the same param tree."""
+    from sav_tpu.models.layers.attention import TalkingHeadsBlock
+
+    block = TalkingHeadsBlock(num_heads=4)
+    x = jax.random.normal(jax.random.PRNGKey(0), (2, 4, 8, 8))
+    v1 = block.init(jax.random.PRNGKey(1), x)
+    v2 = block.init(jax.random.PRNGKey(1), None)
+    assert jax.tree.structure(v1) == jax.tree.structure(v2)
+    kernel = block.apply(v1, None)
+    assert kernel.shape == (4, 4)
+    ref = jnp.einsum("hi,bhqk->biqk", kernel, x)
+    np.testing.assert_allclose(np.asarray(block.apply(v1, x)), np.asarray(ref), rtol=1e-6)

@@ -142,3 +142,58 @@ def test_bf16_dtype():
     assert leaf.dtype == jnp.float32
     logits = model.apply(variables, x, is_training=False)
     chex.assert_shape(logits, (2, 10))
+
+
+def test_cait_pallas_backend_matches_xla():
+    """CaiT's talking-heads trunk rides the fused kernel under
+    backend='pallas' (VERDICT r2 item 7); logits must match the XLA path."""
+    import numpy as np
+
+    x = jax.random.normal(jax.random.PRNGKey(0), (2, 32, 32, 3))
+    outs = {}
+    for backend in ("xla", "pallas"):
+        model = models.CaiT(
+            num_classes=10, embed_dim=32, num_layers=2, num_heads=2,
+            num_layers_token_only=1, patch_shape=(8, 8), backend=backend,
+        )
+        variables = model.init(
+            {"params": jax.random.PRNGKey(1)}, x, is_training=False
+        )
+        params = dict(variables["params"])
+        params["head"] = {
+            "kernel": jax.random.normal(
+                jax.random.PRNGKey(2), params["head"]["kernel"].shape
+            ) * 0.05,
+            "bias": jnp.zeros_like(params["head"]["bias"]),
+        }
+        outs[backend] = np.asarray(
+            model.apply({"params": params}, x, is_training=False)
+        )
+    np.testing.assert_allclose(outs["pallas"], outs["xla"], atol=5e-5, rtol=5e-4)
+
+
+def test_cait_pallas_backward_runs_and_matches():
+    import numpy as np
+
+    x = jax.random.normal(jax.random.PRNGKey(0), (2, 32, 32, 3))
+    grads = {}
+    for backend in ("xla", "pallas"):
+        model = models.CaiT(
+            num_classes=10, embed_dim=32, num_layers=2, num_heads=2,
+            num_layers_token_only=1, patch_shape=(8, 8), backend=backend,
+        )
+        variables = model.init(
+            {"params": jax.random.PRNGKey(1)}, x, is_training=False
+        )
+
+        def loss(params):
+            out = model.apply({"params": params}, x, is_training=False)
+            return jnp.sum(out.astype(jnp.float32) ** 2)
+
+        grads[backend] = jax.grad(loss)(variables["params"])
+    flat_p, _ = jax.tree.flatten(grads["pallas"])
+    flat_x, _ = jax.tree.flatten(grads["xla"])
+    for a, b in zip(flat_p, flat_x):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), atol=1e-4, rtol=5e-3
+        )

@@ -27,6 +27,7 @@ import click
 @click.option("--image-size", type=int, default=224)
 @click.option("--batch-size", type=int, default=1024, help="Global batch size.")
 @click.option("--num-epochs", type=int, default=300)
+@click.option("--warmup-epochs", type=int, default=5)
 @click.option("--learning-rate", type=float, default=5e-4, help="Base LR (×bs/512).")
 @click.option("--weight-decay", type=float, default=0.05)
 @click.option("--label-smoothing", type=float, default=0.1)
@@ -36,6 +37,11 @@ import click
 @click.option(
     "-a", "--augmentation", default="cutmix_mixup_randaugment_405",
     help="Augment-string DSL (SURVEY.md §2.4).",
+)
+@click.option(
+    "--patch-size", type=int, default=None,
+    help="Override the model's patch size (e.g. 4 for 32x32 inputs so the "
+    "token grid stays meaningful at small resolutions).",
 )
 @click.option("--backend", type=click.Choice(["auto", "xla", "pallas"]), default="auto")
 @click.option("--dtype", type=click.Choice(["bfloat16", "float32"]), default="bfloat16")
@@ -47,22 +53,45 @@ import click
 )
 @click.option("-c", "--checkpoint-dir", type=str, default=None)
 @click.option("--steps", type=int, default=None, help="Override total steps.")
+@click.option(
+    "--num-train-images", type=int, default=None,
+    help="Train-split size for non-ImageNet TFRecord datasets "
+    "(disables the 10k VALID carve-out and the 1-indexed label shift).",
+)
+@click.option(
+    "--num-eval-images", type=int, default=None,
+    help="Eval-split size for non-ImageNet TFRecord datasets.",
+)
 @click.option("--seed", type=int, default=42)
 @click.pass_context
 def main(
     ctx, data_dir, fake_data, model_name, num_classes, image_size, batch_size,
-    num_epochs, learning_rate, weight_decay, label_smoothing, clip_grad,
-    grad_accum, augmentation, backend, dtype, tp, fsdp, preset, checkpoint_dir,
-    steps, seed,
+    num_epochs, warmup_epochs, learning_rate, weight_decay, label_smoothing,
+    clip_grad, grad_accum, augmentation, patch_size, backend, dtype, tp, fsdp,
+    preset, checkpoint_dir, steps, num_train_images, num_eval_images, seed,
 ):
     import jax
 
-    from sav_tpu.data.pipeline import Split, load
     from sav_tpu.parallel import distributed_init
     from sav_tpu.train import TrainConfig, Trainer, get_preset
 
+    # Claim the accelerator for JAX BEFORE the data pipeline pulls in
+    # TensorFlow: on single-tenant TPU leases, letting TF probe the device
+    # first can deadlock JAX's init (sav_tpu/data/pipeline.py hides devices
+    # from TF as well — both orderings are defended).
     distributed_init()
     n_devices = len(jax.devices())
+
+    from sav_tpu.data.pipeline import Split, load
+
+    if (num_train_images is None) != (num_eval_images is None):
+        # Both flags flip the TFRecord reader into custom-dataset mode
+        # (0-indexed labels, no VALID carve-out); mixing modes between train
+        # and eval would silently corrupt eval labels.
+        raise click.UsageError(
+            "--num-train-images and --num-eval-images must be passed together"
+        )
+
     mesh_axes = None
     if tp > 1 or fsdp > 1:
         mesh_axes = {"data": n_devices // (tp * fsdp)}
@@ -80,6 +109,7 @@ def main(
         global_batch_size=batch_size,
         augment=augmentation,
         num_epochs=num_epochs,
+        warmup_epochs=warmup_epochs,
         base_lr=learning_rate,
         weight_decay=weight_decay,
         label_smoothing=label_smoothing,
@@ -88,6 +118,11 @@ def main(
         mesh_axes=mesh_axes,
         checkpoint_dir=checkpoint_dir,
         seed=seed,
+        **(
+            {"num_train_images": num_train_images}
+            if num_train_images is not None
+            else {}
+        ),
     )
     if preset is not None:
         # Preset supplies the recipe; flags the user explicitly passed on the
@@ -126,19 +161,56 @@ def main(
     if jax.process_index() == 0:
         click.echo(config.to_json())
 
+    model = None
+    if patch_size is not None:
+        import jax.numpy as jnp
+
+        from sav_tpu.models import create_model
+
+        model = create_model(
+            config.model_name,
+            num_classes=config.num_classes,
+            dtype=jnp.bfloat16 if config.compute_dtype == "bfloat16" else jnp.float32,
+            backend=config.attention_backend,
+            patch_shape=(patch_size, patch_size),
+        )
+    trainer = Trainer(config, model=model)
+    # Restore BEFORE building the train stream so the data iterator starts
+    # at the restored step: deterministic per-epoch pipelines make resume
+    # replay the uninterrupted run's batch schedule (the reference lost
+    # iterator position on preemption — train.py never even restored).
+    state = trainer.restore_or_init()
+    start_step = int(jax.device_get(state.step))
+
     per_host_batch = batch_size // jax.process_count()
-    train_iter = load(
-        Split.TRAIN,
-        data_dir=data_dir,
-        is_training=True,
-        batch_dims=[per_host_batch],
-        image_size=image_size,
-        augment_name=augmentation,
-        transpose=config.transpose_images,
-        bfloat16=dtype == "bfloat16",
-        fake_data=fake_data,
-        seed=seed,
-    )
+    if fake_data:
+        train_iter = load(
+            Split.TRAIN,
+            data_dir=data_dir,
+            is_training=True,
+            batch_dims=[per_host_batch],
+            image_size=image_size,
+            augment_name=augmentation,
+            transpose=config.transpose_images,
+            bfloat16=dtype == "bfloat16",
+            fake_data=True,
+            seed=seed,
+        )
+    else:
+        from sav_tpu.data.pipeline import resumable_train_iterator
+
+        train_iter = resumable_train_iterator(
+            Split.TRAIN,
+            start_step=start_step,
+            seed=seed,
+            data_dir=data_dir,
+            batch_dims=[per_host_batch],
+            image_size=image_size,
+            augment_name=augmentation,
+            transpose=config.transpose_images,
+            bfloat16=dtype == "bfloat16",
+            split_examples=num_train_images,
+        )
 
     def eval_iter_fn():
         return load(
@@ -150,9 +222,8 @@ def main(
             transpose=config.transpose_images,
             bfloat16=dtype == "bfloat16",
             fake_data=fake_data,
+            split_examples=num_eval_images,
         )
-
-    trainer = Trainer(config)
 
     def log_fn(metrics):
         if jax.process_index() == 0:
@@ -162,6 +233,7 @@ def main(
         train_iter,
         num_steps=steps,
         eval_iter_fn=None if fake_data else eval_iter_fn,
+        state=state,
         log_fn=log_fn,
     )
     if jax.process_index() == 0:

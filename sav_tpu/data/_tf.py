@@ -1,0 +1,33 @@
+"""Guarded TensorFlow import: TF serves host-side data only.
+
+Every sav_tpu module that needs TF imports it from here, so device hiding
+runs no matter which entry point loads first. JAX owns the accelerator; a
+TF claim on a single-tenant TPU lease can deadlock JAX's device init
+outright (the reference fought the milder version of this battle,
+/root/reference/input_pipeline.py:228-231).
+"""
+
+from __future__ import annotations
+
+import logging
+
+try:
+    import tensorflow as tf
+except ImportError:  # pragma: no cover
+    tf = None
+
+if tf is not None:
+    for _kind in ("TPU", "GPU"):
+        try:
+            tf.config.set_visible_devices([], _kind)
+        except Exception as e:  # pragma: no cover - env-dependent
+            # Most likely "visible devices cannot be modified after being
+            # initialized" — the hazard window is real, so say so instead
+            # of failing silently.
+            logging.getLogger(__name__).warning(
+                "could not hide %s devices from TensorFlow (%s); if JAX "
+                "device init hangs, import sav_tpu.data before running any "
+                "TF op",
+                _kind,
+                e,
+            )

@@ -11,7 +11,7 @@ from __future__ import annotations
 
 from typing import Callable
 
-import tensorflow as tf
+from sav_tpu.data._tf import tf
 
 from sav_tpu.data import image_ops as ops
 

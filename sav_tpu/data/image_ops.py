@@ -12,7 +12,7 @@ from __future__ import annotations
 
 import math
 
-import tensorflow as tf
+from sav_tpu.data._tf import tf
 
 _GRAY = tf.constant([128] * 3, tf.float32)
 

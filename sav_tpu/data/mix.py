@@ -24,7 +24,7 @@ mixes one-hot targets accordingly (/root/reference/train.py:84-87 behavior).
 
 from __future__ import annotations
 
-import tensorflow as tf
+from sav_tpu.data._tf import tf
 
 
 def _sample_beta(shape, alpha: float) -> tf.Tensor:

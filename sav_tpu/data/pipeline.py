@@ -30,20 +30,9 @@ from typing import Generator, Optional, Sequence
 
 import numpy as np
 
-try:  # TF is only needed for the real pipeline, not for fake data.
-    import tensorflow as tf
-except ImportError:  # pragma: no cover
-    tf = None
-else:
-    # TF must never claim the accelerator — it serves host-side data only
-    # while JAX owns the TPU (the reference fought exactly this battle,
-    # input_pipeline.py:228-231; on single-tenant TPU leases a TF claim can
-    # deadlock JAX's device init outright).
-    try:
-        tf.config.set_visible_devices([], "TPU")
-        tf.config.set_visible_devices([], "GPU")
-    except Exception:  # pragma: no cover - older TF / no such device type
-        pass
+# TF is only needed for the real pipeline, not for fake data; the guarded
+# import hides accelerators from TF (see sav_tpu/data/_tf.py).
+from sav_tpu.data._tf import tf
 
 try:
     import ml_dtypes

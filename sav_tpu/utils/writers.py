@@ -101,7 +101,7 @@ class TensorBoardWriter:
         self._tf = None
         self._writer = None
         try:
-            import tensorflow as tf  # type: ignore
+            from sav_tpu.data._tf import tf  # type: ignore
         except ImportError:
             return  # library absent → silent no-op (documented behavior)
         try:

@@ -75,22 +75,23 @@ def main(
     from sav_tpu.parallel import distributed_init
     from sav_tpu.train import TrainConfig, Trainer, get_preset
 
+    if (num_train_images is None) != (num_eval_images is None):
+        # Both flags flip the TFRecord reader into custom-dataset mode
+        # (0-indexed labels, no VALID carve-out); mixing modes between train
+        # and eval would silently corrupt eval labels. Checked before any
+        # cluster rendezvous so usage errors fail fast.
+        raise click.UsageError(
+            "--num-train-images and --num-eval-images must be passed together"
+        )
+
     # Claim the accelerator for JAX BEFORE the data pipeline pulls in
     # TensorFlow: on single-tenant TPU leases, letting TF probe the device
-    # first can deadlock JAX's init (sav_tpu/data/pipeline.py hides devices
+    # first can deadlock JAX's init (sav_tpu/data/_tf.py hides devices
     # from TF as well — both orderings are defended).
     distributed_init()
     n_devices = len(jax.devices())
 
     from sav_tpu.data.pipeline import Split, load
-
-    if (num_train_images is None) != (num_eval_images is None):
-        # Both flags flip the TFRecord reader into custom-dataset mode
-        # (0-indexed labels, no VALID carve-out); mixing modes between train
-        # and eval would silently corrupt eval labels.
-        raise click.UsageError(
-            "--num-train-images and --num-eval-images must be passed together"
-        )
 
     mesh_axes = None
     if tp > 1 or fsdp > 1:

@@ -16,6 +16,19 @@ try:
 except ImportError:  # pragma: no cover
     tf = None
 
+def require_tf():
+    """Return the tf module or raise a clear ImportError when TF is absent
+    (the guarded import above exports ``tf = None`` instead of raising, so
+    downstream modules would otherwise die with a confusing
+    ``NoneType has no attribute ...``)."""
+    if tf is None:
+        raise ImportError(
+            "tensorflow is required for sav_tpu's host-side data pipeline "
+            "(images ops / mixes / TFRecord reading) but is not installed"
+        )
+    return tf
+
+
 if tf is not None:
     for _kind in ("TPU", "GPU"):
         try:

@@ -11,7 +11,9 @@ from __future__ import annotations
 
 from typing import Callable
 
-from sav_tpu.data._tf import tf
+from sav_tpu.data._tf import require_tf
+
+tf = require_tf()
 
 from sav_tpu.data import image_ops as ops
 

@@ -12,7 +12,9 @@ from __future__ import annotations
 
 import math
 
-from sav_tpu.data._tf import tf
+from sav_tpu.data._tf import require_tf
+
+tf = require_tf()
 
 _GRAY = tf.constant([128] * 3, tf.float32)
 

@@ -24,7 +24,9 @@ mixes one-hot targets accordingly (/root/reference/train.py:84-87 behavior).
 
 from __future__ import annotations
 
-from sav_tpu.data._tf import tf
+from sav_tpu.data._tf import require_tf
+
+tf = require_tf()
 
 
 def _sample_beta(shape, alpha: float) -> tf.Tensor:

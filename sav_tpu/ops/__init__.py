@@ -1,4 +1,8 @@
-from sav_tpu.ops.attention import dot_product_attention, xla_attention
+from sav_tpu.ops.attention import (
+    dot_product_attention,
+    xla_attention,
+    xla_attention_fast,
+)
 from sav_tpu.ops.flash_attention import flash_attention, flash_botnet_attention
 from sav_tpu.ops.relative import relative_logits_2d
 from sav_tpu.ops.rotary import fixed_positional_embedding, apply_rotary_pos_emb
@@ -6,6 +10,7 @@ from sav_tpu.ops.rotary import fixed_positional_embedding, apply_rotary_pos_emb
 __all__ = [
     "dot_product_attention",
     "xla_attention",
+    "xla_attention_fast",
     "flash_attention",
     "flash_botnet_attention",
     "relative_logits_2d",

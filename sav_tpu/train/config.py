@@ -38,6 +38,12 @@ class TrainConfig:
     warmup_epochs: int = 5
     weight_decay: float = 0.05
     clip_grad_norm: Optional[float] = 1.0
+    # Adam moment updates on one flat buffer (optax.flatten) — kills per-leaf
+    # kernel-launch overhead. None = auto: on for pure data-parallel meshes,
+    # off whenever a model/fsdp/expert axis exists (a flat moment vector
+    # cannot shard like its parameters). False also keeps the per-leaf
+    # opt-state layout of pre-round-3 checkpoints.
+    fused_optimizer: Optional[bool] = None
     label_smoothing: float = 0.1
     aux_loss_weight: float = 0.01  # weight on sown 'losses' (MoE balance etc.)
     grad_accum_steps: int = 1  # micro-batches per optimizer update

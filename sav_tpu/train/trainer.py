@@ -68,10 +68,16 @@ class Trainer:
             num_epochs=config.num_epochs,
             end_lr=config.end_lr,
         )
+        fused_opt = config.fused_optimizer
+        if fused_opt is None:
+            # Flat Adam moments can't be sharded like their parameters —
+            # auto-enable only when params are replicated (no non-data axis).
+            fused_opt = all(name == "data" for name in self.mesh.axis_names)
         self.tx = make_optimizer(
             self.schedule,
             weight_decay=config.weight_decay,
             clip_grad_norm=config.clip_grad_norm,
+            fused=fused_opt,
         )
         self.checkpointer = checkpointer
         if checkpointer is None and config.checkpoint_dir:

@@ -104,6 +104,8 @@ class TensorBoardWriter:
             from sav_tpu.data._tf import tf  # type: ignore
         except ImportError:
             return  # library absent → silent no-op (documented behavior)
+        if tf is None:  # guarded import exports None instead of raising
+            return
         try:
             self._writer = tf.summary.create_file_writer(log_dir)
             self._tf = tf

@@ -240,3 +240,112 @@ def test_talking_heads_block_kernel_accessor():
     assert kernel.shape == (4, 4)
     ref = jnp.einsum("hi,bhqk->biqk", kernel, x)
     np.testing.assert_allclose(np.asarray(block.apply(v1, x)), np.asarray(ref), rtol=1e-6)
+
+
+@pytest.mark.parametrize(
+    "lq,lk,d,with_bias",
+    [
+        (197, 197, 64, False),  # DeiT-S flagship shape
+        (1, 197, 64, False),  # class attention
+        (196, 49, 64, False),  # CvT downsampled K/V
+        (50, 50, 32, True),  # bias gradient path
+    ],
+)
+def test_fast_vjp_matches_autodiff_f32(lq, lk, d, with_bias):
+    """xla_attention_fast: hand-written VJP vs autodiff of the reference
+    path. In f32 the residual-storage dtype matches, so gradients agree to
+    matmul-reassociation tolerance."""
+    from sav_tpu.ops.attention import xla_attention_fast
+
+    q, k, v = _qkv(lq=lq, lk=lk, d=d)
+    bias = (
+        jax.random.normal(jax.random.PRNGKey(9), (1, 4, lq, lk))
+        if with_bias
+        else None
+    )
+    args = (q, k, v) if bias is None else (q, k, v, bias)
+    argnums = tuple(range(len(args)))
+
+    def loss_f(fn):
+        return lambda *a: jnp.sum(jnp.square(fn(*a)))
+
+    out_fast = xla_attention_fast(*args)
+    out_ref = xla_attention(*args)
+    np.testing.assert_allclose(
+        np.asarray(out_fast), np.asarray(out_ref), atol=2e-5, rtol=2e-5
+    )
+    gf = jax.grad(loss_f(xla_attention_fast), argnums=argnums)(*args)
+    gx = jax.grad(loss_f(xla_attention), argnums=argnums)(*args)
+    for a, b in zip(gf, gx):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), atol=1e-4, rtol=5e-4
+        )
+
+
+def test_fast_vjp_bf16_close_to_f32_chain():
+    """bf16 inputs: fast-VJP gradients stay within bf16 quantization of the
+    all-f32 gradient chain (the correctness bound claimed in the docstring)."""
+    from sav_tpu.ops.attention import xla_attention_fast
+
+    q, k, v = _qkv(lq=197, lk=197, d=64, dtype=jnp.bfloat16)
+    q32, k32, v32 = (x.astype(jnp.float32) for x in (q, k, v))
+
+    def loss(fn, *a):
+        return jnp.sum(jnp.square(fn(*a).astype(jnp.float32)))
+
+    gf = jax.grad(lambda *a: loss(xla_attention_fast, *a), argnums=(0, 1, 2))(
+        q, k, v
+    )
+    g32 = jax.grad(lambda *a: loss(xla_attention, *a), argnums=(0, 1, 2))(
+        q32, k32, v32
+    )
+    for a, b in zip(gf, g32):
+        a = np.asarray(a, np.float32)
+        b = np.asarray(b, np.float32)
+        assert np.all(np.isfinite(a))
+        denom = np.maximum(np.abs(b), 1e-3)
+        assert np.median(np.abs(a - b) / denom) < 2e-2
+
+
+def test_dot_product_attention_xla_uses_fast_path_numerics():
+    """Dispatcher's deterministic XLA branch returns fast-path results."""
+    q, k, v = _qkv(lq=64, lk=64, d=32)
+    out = dot_product_attention(q, k, v, backend="xla")
+    ref = xla_attention(q, k, v)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5, rtol=2e-5)
+
+
+def test_fast_vjp_bf16_bias_cotangent_dtype():
+    """bf16 bias (the BoTNet training configuration): dbias must come back
+    in the primal dtype or custom_vjp rejects the cotangent at trace time."""
+    from sav_tpu.ops.attention import xla_attention_fast
+
+    q, k, v = _qkv(lq=50, lk=50, d=32, dtype=jnp.bfloat16)
+    bias = jax.random.normal(
+        jax.random.PRNGKey(9), (1, 4, 50, 50), jnp.bfloat16
+    )
+    g = jax.grad(
+        lambda b: jnp.sum(jnp.square(xla_attention_fast(q, k, v, b).astype(jnp.float32)))
+    )(bias)
+    assert g.dtype == jnp.bfloat16
+    assert np.all(np.isfinite(np.asarray(g, np.float32)))
+
+
+@pytest.mark.parametrize("bias_shape", [(4, 24, 24), (24, 24), (1, 24)])
+def test_fast_vjp_low_rank_bias_matches_autodiff(bias_shape):
+    """Bias with rank < logits rank broadcasts from the right; the hand
+    VJP must reduce accordingly (left-aligned pairing is wrong/crashes)."""
+    from sav_tpu.ops.attention import xla_attention_fast
+
+    q, k, v = _qkv(lq=24, lk=24, d=16)
+    bias = jax.random.normal(jax.random.PRNGKey(3), bias_shape)
+
+    def loss_f(fn):
+        return lambda q, k, v, b: jnp.sum(jnp.square(fn(q, k, v, b)))
+
+    gf = jax.grad(loss_f(xla_attention_fast), argnums=(0, 1, 2, 3))(q, k, v, bias)
+    gx = jax.grad(loss_f(xla_attention), argnums=(0, 1, 2, 3))(q, k, v, bias)
+    for a, b in zip(gf, gx):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), atol=1e-4, rtol=5e-4
+        )

@@ -62,13 +62,20 @@ import click
     "--num-eval-images", type=int, default=None,
     help="Eval-split size for non-ImageNet TFRecord datasets.",
 )
+@click.option(
+    "--fused-optimizer/--no-fused-optimizer", default=None,
+    help="Adam moments on one flat buffer (default: auto — on for pure "
+    "data-parallel meshes). Pass --no-fused-optimizer to resume checkpoints "
+    "written with the per-leaf optimizer-state layout (pre-round-3).",
+)
 @click.option("--seed", type=int, default=42)
 @click.pass_context
 def main(
     ctx, data_dir, fake_data, model_name, num_classes, image_size, batch_size,
     num_epochs, warmup_epochs, learning_rate, weight_decay, label_smoothing,
     clip_grad, grad_accum, augmentation, patch_size, backend, dtype, tp, fsdp,
-    preset, checkpoint_dir, steps, num_train_images, num_eval_images, seed,
+    preset, checkpoint_dir, steps, num_train_images, num_eval_images,
+    fused_optimizer, seed,
 ):
     import jax
 
@@ -116,6 +123,7 @@ def main(
         label_smoothing=label_smoothing,
         clip_grad_norm=clip_grad,
         grad_accum_steps=grad_accum,
+        fused_optimizer=fused_optimizer,
         mesh_axes=mesh_axes,
         checkpoint_dir=checkpoint_dir,
         seed=seed,

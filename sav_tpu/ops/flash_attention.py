@@ -567,6 +567,34 @@ def flash_attention(
 # ---------------------------------------------------------------------------
 
 
+def _rel_selection_mats(ki, block_kv, wp, hp, width):
+    """Iota-built 0/1 selection matrices for one kv block:
+    ``S_w[r, c] = (kw(ki·block_kv + c) == r)`` (and ``kh`` for S_h), so
+    ``bias_blk = rw_abs_blk @ S_w + rh_abs_blk @ S_h`` — two small MXU
+    matmuls instead of a gather. Shared by the forward and both backward
+    kernels (the backward's ``d_rw = dS @ S_wᵀ`` is the exact transpose)."""
+
+    def selection(rows, key_coord):
+        col = ki * block_kv + jax.lax.broadcasted_iota(
+            jnp.int32, (rows, block_kv), 1
+        )
+        row = jax.lax.broadcasted_iota(jnp.int32, (rows, block_kv), 0)
+        return (key_coord(col) == row).astype(jnp.float32)
+
+    sel_w = selection(wp, lambda c: c % width)
+    sel_h = selection(hp, lambda c: c // width)
+    return sel_w, sel_h
+
+
+def _rel_bias_block(rw, rh, sel_w, sel_h):
+    bias = jax.lax.dot_general(
+        rw, sel_w, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
+    )
+    return bias + jax.lax.dot_general(
+        rh, sel_h, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
+    )
+
+
 def _rel_kernel(
     q_ref,
     k_ref,
@@ -574,16 +602,18 @@ def _rel_kernel(
     rw_ref,
     rh_ref,
     o_ref,
-    m_scr,
-    l_scr,
-    acc_scr,
-    *,
+    *rest,
     scale: float,
     kv_len: int,
     block_kv: int,
     num_kv_blocks: int,
     width: int,
+    with_lse: bool,
 ):
+    if with_lse:
+        lse_ref, m_scr, l_scr, acc_scr = rest
+    else:
+        (m_scr, l_scr, acc_scr), lse_ref = rest, None
     ki = pl.program_id(2)
 
     @pl.when(ki == 0)
@@ -599,44 +629,31 @@ def _rel_kernel(
     )
     s = s * scale
 
-    # Expand the absolute per-axis logits to this block's bias with two
-    # small MXU matmuls against iota-built selection matrices:
-    #   bias[q, k] = rw_abs[q, kw(k)] + rh_abs[q, kh(k)]
-    #   S_w[r, k] = (kw(k) == r)  →  bias_w = rw_abs_blk @ S_w.
+    # Expand the absolute per-axis logits to this block's bias:
+    #   bias[q, k] = rw_abs[q, kw(k)] + rh_abs[q, kh(k)].
     # Padded rows of rw/rh are zero and padded selection rows never match,
     # so padding contributes nothing; padded kv columns are masked below.
     rw = rw_ref[0]  # [block_q, pad(W)] f32
     rh = rh_ref[0]  # [block_q, pad(H)] f32
-
-    def selection(rows, key_coord):
-        col = ki * block_kv + jax.lax.broadcasted_iota(
-            jnp.int32, (rows, block_kv), 1
-        )
-        row = jax.lax.broadcasted_iota(jnp.int32, (rows, block_kv), 0)
-        return (key_coord(col) == row).astype(jnp.float32)
-
-    sel_w = selection(rw.shape[1], lambda c: c % width)
-    sel_h = selection(rh.shape[1], lambda c: c // width)
-    bias = jax.lax.dot_general(
-        rw, sel_w, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
+    sel_w, sel_h = _rel_selection_mats(
+        ki, block_kv, rw.shape[1], rh.shape[1], width
     )
-    bias = bias + jax.lax.dot_general(
-        rh, sel_h, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
-    )
-    s = s + bias
+    s = s + _rel_bias_block(rw, rh, sel_w, sel_h)
 
     if num_kv_blocks * block_kv != kv_len:
         kcol = ki * block_kv + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
         s = jnp.where(kcol < kv_len, s, _NEG_INF)
 
     _online_softmax_step(s, v_ref[0], o_ref, m_scr, l_scr, acc_scr, ki,
-                         num_kv_blocks, 0)
+                         num_kv_blocks, 0, lse_ref=lse_ref)
 
 
 def _rel_forward(q, k, v, rw_abs, rh_abs, height, width, scale, block_q,
-                 block_kv, interpret):
+                 block_kv, interpret, with_lse=False):
     """q/k/v ``[B, L, H, D]``; rw_abs/rh_abs ``[B, heads, L, W / H]`` f32
-    absolute per-axis relative-position logits."""
+    absolute per-axis relative-position logits. ``with_lse`` additionally
+    returns the ``[B·H, padded_q_len, 128]`` per-row logsumexp residual the
+    blocked backward consumes."""
     batch, q_len, heads, dim = q.shape
     kv_len = k.shape[1]
     if interpret is None:
@@ -677,8 +694,22 @@ def _rel_forward(q, k, v, rw_abs, rh_abs, height, width, scale, block_q,
         block_kv=block_kv,
         num_kv_blocks=num_kv_blocks,
         width=width,
+        with_lse=with_lse,
     )
-    out = pl.pallas_call(
+    out_specs = [
+        pl.BlockSpec((1, block_q, dim_p), lambda b, i, j: (b, i, 0))
+    ]
+    out_shape = [
+        jax.ShapeDtypeStruct((batch * heads, q_len_p, dim_p), q.dtype)
+    ]
+    if with_lse:
+        out_specs.append(
+            pl.BlockSpec((1, block_q, 128), lambda b, i, j: (b, i, 0))
+        )
+        out_shape.append(
+            jax.ShapeDtypeStruct((batch * heads, q_len_p, 128), jnp.float32)
+        )
+    outs = pl.pallas_call(
         kernel,
         grid=grid,
         in_specs=[
@@ -692,8 +723,8 @@ def _rel_forward(q, k, v, rw_abs, rh_abs, height, width, scale, block_q,
                 (1, block_q, rhf.shape[-1]), lambda b, i, j: (b, i, 0)
             ),
         ],
-        out_specs=pl.BlockSpec((1, block_q, dim_p), lambda b, i, j: (b, i, 0)),
-        out_shape=jax.ShapeDtypeStruct((batch * heads, q_len_p, dim_p), q.dtype),
+        out_specs=out_specs if with_lse else out_specs[0],
+        out_shape=out_shape if with_lse else out_shape[0],
         scratch_shapes=[
             pltpu.VMEM((1, block_q, 128), jnp.float32),
             pltpu.VMEM((1, block_q, 128), jnp.float32),
@@ -701,8 +732,12 @@ def _rel_forward(q, k, v, rw_abs, rh_abs, height, width, scale, block_q,
         ],
         interpret=interpret,
     )(qf, kf, vf, rwf, rhf)
-    out = out[:, :q_len, :dim].reshape(batch, heads, q_len, dim)
-    return jnp.transpose(out, (0, 2, 1, 3))
+    out_raw = outs[0] if with_lse else outs
+    out = out_raw[:, :q_len, :dim].reshape(batch, heads, q_len, dim)
+    out = jnp.transpose(out, (0, 2, 1, 3))
+    if with_lse:
+        return out, outs[1]
+    return out
 
 
 def compact_to_absolute(cw: jax.Array, ch: jax.Array, height: int,
@@ -738,17 +773,240 @@ def expand_relative_bias(rw_abs: jax.Array, rh_abs: jax.Array, height: int,
     return bias.reshape(b, h, l, l)
 
 
-def _dense_rel_reference(q, k, v, rw_abs, rh_abs, height, width, scale):
-    """Dense attention with expanded relative bias (backward recompute)."""
-    mm = q.dtype
-    s = jnp.einsum(
-        "bqhd,bkhd->bhqk", q, k, preferred_element_type=jnp.float32
+def _rel_recompute_ds(q, k, v, do, rw, rh, lse_row, delta_row, ki, qi, *,
+                      scale, q_len, kv_len, block_q, block_kv, width):
+    """Shared backward recompute for one (q block, kv block) pair: rebuild
+    the biased logits, normalize against the forward lse, mask padded
+    rows/cols, and return ``(p, ds)``. Single source of recompute semantics
+    for both backward kernels (dq and dk/dv)."""
+    sel_w, sel_h = _rel_selection_mats(
+        ki, block_kv, rw.shape[1], rh.shape[1], width
+    )
+    s = jax.lax.dot_general(
+        q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
     ) * scale
-    s = s + expand_relative_bias(rw_abs, rh_abs, height, width)
-    p = jax.nn.softmax(s, axis=-1)
-    return jnp.einsum(
-        "bhqk,bkhd->bqhd", p.astype(mm), v, preferred_element_type=jnp.float32
-    ).astype(q.dtype)
+    s = s + _rel_bias_block(rw, rh, sel_w, sel_h)
+    p = jnp.exp(s - _lanes(lse_row, s.shape[1]))
+    if kv_len % block_kv != 0:
+        col = ki * block_kv + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+        p = jnp.where(col < kv_len, p, 0.0)
+    if q_len % block_q != 0:
+        row = qi * block_q + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
+        p = jnp.where(row < q_len, p, 0.0)
+    dp = jax.lax.dot_general(
+        do, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+    )
+    ds = p * (dp - _lanes(delta_row, s.shape[1]))
+    return p, ds, sel_w, sel_h
+
+
+def _rel_bwd_dq_kernel(q_ref, k_ref, v_ref, rw_ref, rh_ref, do_ref, lse_ref,
+                       delta_ref, dq_ref, drw_ref, drh_ref, dq_acc, drw_acc,
+                       drh_acc, *, scale: float, q_len: int, kv_len: int,
+                       block_q: int, block_kv: int, num_kv_blocks: int,
+                       width: int):
+    """dq + per-axis relative-logit gradients, kv-innermost grid.
+
+    dS w.r.t. the bias factors through the selection matmuls:
+    ``d_rw = dS @ S_wᵀ`` — the row-sum of dS over key columns sharing a
+    width coordinate (and S_h for height). Accumulated per q block, so the
+    dense ``[B,H,L,L]`` bias gradient never exists in HBM."""
+    ki = pl.program_id(2)
+
+    @pl.when(ki == 0)
+    def _init():
+        dq_acc[...] = jnp.zeros_like(dq_acc)
+        drw_acc[...] = jnp.zeros_like(drw_acc)
+        drh_acc[...] = jnp.zeros_like(drh_acc)
+
+    q, k, v, do = q_ref[0], k_ref[0], v_ref[0], do_ref[0]
+    _, ds, sel_w, sel_h = _rel_recompute_ds(
+        q, k, v, do, rw_ref[0], rh_ref[0], lse_ref[0], delta_ref[0],
+        ki, pl.program_id(1), scale=scale, q_len=q_len, kv_len=kv_len,
+        block_q=block_q, block_kv=block_kv, width=width,
+    )
+    dq_acc[0] += jax.lax.dot_general(
+        ds.astype(k.dtype), k, (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    ) * scale
+    drw_acc[0] += jax.lax.dot_general(
+        ds, sel_w, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+    drh_acc[0] += jax.lax.dot_general(
+        ds, sel_h, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+
+    @pl.when(ki == num_kv_blocks - 1)
+    def _write():
+        dq_ref[...] = dq_acc[...].astype(dq_ref.dtype)
+        drw_ref[...] = drw_acc[...]
+        drh_ref[...] = drh_acc[...]
+
+
+def _rel_bwd_dkv_kernel(q_ref, k_ref, v_ref, rw_ref, rh_ref, do_ref, lse_ref,
+                        delta_ref, dk_ref, dv_ref, dk_acc, dv_acc, *,
+                        scale: float, q_len: int, kv_len: int, block_q: int,
+                        block_kv: int, num_q_blocks: int, width: int):
+    """dk/dv, q-innermost grid; kv block index is grid axis 1."""
+    ki = pl.program_id(1)
+    qi = pl.program_id(2)
+
+    @pl.when(qi == 0)
+    def _init():
+        dk_acc[...] = jnp.zeros_like(dk_acc)
+        dv_acc[...] = jnp.zeros_like(dv_acc)
+
+    q, k, v, do = q_ref[0], k_ref[0], v_ref[0], do_ref[0]
+    p, ds, _, _ = _rel_recompute_ds(
+        q, k, v, do, rw_ref[0], rh_ref[0], lse_ref[0], delta_ref[0],
+        ki, qi, scale=scale, q_len=q_len, kv_len=kv_len,
+        block_q=block_q, block_kv=block_kv, width=width,
+    )
+    dv_acc[0] += jax.lax.dot_general(
+        p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+    dk_acc[0] += jax.lax.dot_general(
+        ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    ) * scale
+
+    @pl.when(qi == num_q_blocks - 1)
+    def _write():
+        dk_ref[...] = dk_acc[...].astype(dk_ref.dtype)
+        dv_ref[...] = dv_acc[...].astype(dv_ref.dtype)
+
+
+def _rel_backward_pallas(q, k, v, rw_abs, rh_abs, out, lse, g, height, width,
+                         scale, block_q, block_kv, interpret):
+    """Blocked backward for the fused rel-pos kernel. Mirrors
+    ``_flash_backward_pallas`` with the bias rebuilt in-kernel and its
+    gradient reduced to the compact per-axis ``[B, H, L, W]/[B, H, L, H]``
+    tables — ``[B,H,L,L]`` never materializes in either direction."""
+    batch, q_len, heads, dim = q.shape
+    kv_len = k.shape[1]
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+
+    def to_bhld(x):
+        b, l, h, d = x.shape
+        return jnp.transpose(x, (0, 2, 1, 3)).reshape(b * h, l, d)
+
+    dim_p = _round_up(dim, 128)
+    block_q = min(block_q, _round_up(q_len, 16))
+    block_kv = min(block_kv, _round_up(kv_len, 16))
+    q_len_p = _round_up(q_len, block_q)
+    kv_len_p = _round_up(kv_len, block_kv)
+
+    def pad3(x, lp):
+        return jnp.pad(x, ((0, 0), (0, lp - x.shape[1]), (0, dim_p - x.shape[2])))
+
+    qf = pad3(to_bhld(q), q_len_p)
+    kf = pad3(to_bhld(k), kv_len_p)
+    vf = pad3(to_bhld(v), kv_len_p)
+    dof = pad3(to_bhld(g), q_len_p)
+
+    def prep_compact(c):
+        bb, hh, ll, rr = c.shape
+        cf = c.reshape(bb * hh, ll, rr).astype(jnp.float32)
+        return jnp.pad(
+            cf, ((0, 0), (0, q_len_p - ll), (0, _round_up(rr, 128) - rr))
+        )
+
+    rwf, rhf = prep_compact(rw_abs), prep_compact(rh_abs)
+    wp, hp = rwf.shape[-1], rhf.shape[-1]
+
+    delta = jnp.sum(g.astype(jnp.float32) * out.astype(jnp.float32), axis=-1)
+    delta = jnp.transpose(delta, (0, 2, 1)).reshape(batch * heads, q_len)
+    delta = jnp.pad(delta, ((0, 0), (0, q_len_p - q_len)))
+    delta = jnp.broadcast_to(delta[:, :, None], delta.shape + (128,))
+
+    num_q_blocks = q_len_p // block_q
+    num_kv_blocks = kv_len_p // block_kv
+    bh = batch * heads
+
+    qspec = pl.BlockSpec((1, block_q, dim_p), lambda b, i, j: (b, i, 0))
+    kspec = pl.BlockSpec((1, block_kv, dim_p), lambda b, i, j: (b, j, 0))
+    rwspec = pl.BlockSpec((1, block_q, wp), lambda b, i, j: (b, i, 0))
+    rhspec = pl.BlockSpec((1, block_q, hp), lambda b, i, j: (b, i, 0))
+    rowq = pl.BlockSpec((1, block_q, 128), lambda b, i, j: (b, i, 0))
+
+    dq, drw, drh = pl.pallas_call(
+        functools.partial(
+            _rel_bwd_dq_kernel,
+            scale=scale,
+            q_len=q_len,
+            kv_len=kv_len,
+            block_q=block_q,
+            block_kv=block_kv,
+            num_kv_blocks=num_kv_blocks,
+            width=width,
+        ),
+        grid=(bh, num_q_blocks, num_kv_blocks),
+        in_specs=[qspec, kspec, kspec, rwspec, rhspec, qspec, rowq, rowq],
+        out_specs=[qspec, rwspec, rhspec],
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, q_len_p, dim_p), q.dtype),
+            jax.ShapeDtypeStruct((bh, q_len_p, wp), jnp.float32),
+            jax.ShapeDtypeStruct((bh, q_len_p, hp), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((1, block_q, dim_p), jnp.float32),
+            pltpu.VMEM((1, block_q, wp), jnp.float32),
+            pltpu.VMEM((1, block_q, hp), jnp.float32),
+        ],
+        interpret=interpret,
+    )(qf, kf, vf, rwf, rhf, dof, lse, delta)
+
+    qspec2 = pl.BlockSpec((1, block_q, dim_p), lambda b, j, i: (b, i, 0))
+    kspec2 = pl.BlockSpec((1, block_kv, dim_p), lambda b, j, i: (b, j, 0))
+    rwspec2 = pl.BlockSpec((1, block_q, wp), lambda b, j, i: (b, i, 0))
+    rhspec2 = pl.BlockSpec((1, block_q, hp), lambda b, j, i: (b, i, 0))
+    rowq2 = pl.BlockSpec((1, block_q, 128), lambda b, j, i: (b, i, 0))
+    dk, dv = pl.pallas_call(
+        functools.partial(
+            _rel_bwd_dkv_kernel,
+            scale=scale,
+            q_len=q_len,
+            kv_len=kv_len,
+            block_q=block_q,
+            block_kv=block_kv,
+            num_q_blocks=num_q_blocks,
+            width=width,
+        ),
+        grid=(bh, num_kv_blocks, num_q_blocks),
+        in_specs=[qspec2, kspec2, kspec2, rwspec2, rhspec2, qspec2, rowq2,
+                  rowq2],
+        out_specs=[kspec2, kspec2],
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, kv_len_p, dim_p), k.dtype),
+            jax.ShapeDtypeStruct((bh, kv_len_p, dim_p), v.dtype),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((1, block_kv, dim_p), jnp.float32),
+            pltpu.VMEM((1, block_kv, dim_p), jnp.float32),
+        ],
+        interpret=interpret,
+    )(qf, kf, vf, rwf, rhf, dof, lse, delta)
+
+    def from_bhld(x, l):
+        x = x[:, :l, :dim].reshape(batch, heads, l, dim)
+        return jnp.transpose(x, (0, 2, 1, 3))
+
+    def from_compact(x, rr, ref):
+        return x[:, :q_len, :rr].reshape(batch, heads, q_len, rr).astype(
+            ref.dtype
+        )
+
+    return (
+        from_bhld(dq, q_len),
+        from_bhld(dk, kv_len),
+        from_bhld(dv, kv_len),
+        from_compact(drw, rw_abs.shape[-1], rw_abs),
+        from_compact(drh, rh_abs.shape[-1], rh_abs),
+    )
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7, 8, 9, 10))
@@ -762,23 +1020,20 @@ def _flash_rel(q, k, v, rw_abs, rh_abs, height, width, scale, block_q,
 
 def _flash_rel_fwd(q, k, v, rw_abs, rh_abs, height, width, scale, block_q,
                    block_kv, interpret):
-    out = _rel_forward(
+    out, lse = _rel_forward(
         q, k, v, rw_abs, rh_abs, height, width, scale, block_q, block_kv,
-        interpret,
+        interpret, with_lse=True,
     )
-    return out, (q, k, v, rw_abs, rh_abs)
+    return out, (q, k, v, rw_abs, rh_abs, out, lse)
 
 
 def _flash_rel_bwd(height, width, scale, block_q, block_kv, interpret,
                    residuals, g):
-    q, k, v, rw_abs, rh_abs = residuals
-    _, vjp = jax.vjp(
-        lambda q, k, v, rw, rh: _dense_rel_reference(
-            q, k, v, rw, rh, height, width, scale
-        ),
-        q, k, v, rw_abs, rh_abs,
+    q, k, v, rw_abs, rh_abs, out, lse = residuals
+    return _rel_backward_pallas(
+        q, k, v, rw_abs, rh_abs, out, lse, g, height, width, scale, block_q,
+        block_kv, interpret,
     )
-    return vjp(g)
 
 
 _flash_rel.defvjp(_flash_rel_fwd, _flash_rel_bwd)
@@ -809,7 +1064,9 @@ def flash_botnet_attention(
 
     Returns:
       ``[B, L, heads, D]`` in the query dtype. Differentiable w.r.t. all
-      five tensor inputs (backward = flash-style XLA recompute).
+      five tensor inputs; the backward is fully blocked Pallas (dq + compact
+      per-axis bias gradients in one kernel, dk/dv in another) — the dense
+      ``[B,H,L,L]`` bias/probability tensors exist in neither direction.
     """
     b, l, heads, d = query.shape
     if l != height * width:

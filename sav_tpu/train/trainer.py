@@ -122,7 +122,20 @@ class Trainer:
     def restore_or_init(self) -> TrainState:
         state = self.init_state()
         if self.checkpointer is not None:
-            restored = self.checkpointer.restore_latest(state)
+            try:
+                restored = self.checkpointer.restore_latest(state)
+            except Exception as e:
+                # The most likely structure mismatch: the checkpoint was
+                # written with the other optimizer-state layout (per-leaf vs
+                # optax.flatten'd — TrainConfig.fused_optimizer). Point at
+                # the switch instead of surfacing a bare Orbax tree error.
+                raise RuntimeError(
+                    "checkpoint restore failed with a state-structure "
+                    "mismatch; if this checkpoint predates the flat-buffer "
+                    "optimizer (round 3), rerun with --no-fused-optimizer "
+                    "(TrainConfig.fused_optimizer=False) to keep the "
+                    "per-leaf Adam state layout"
+                ) from e
             if restored is not None:
                 return restored
         return state

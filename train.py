@@ -63,6 +63,12 @@ import click
     help="Eval-split size for non-ImageNet TFRecord datasets.",
 )
 @click.option(
+    "--platform", type=click.Choice(["auto", "cpu"]), default="auto",
+    help="'cpu' pins JAX to host CPU before backend init (the TPU plugin "
+    "ignores JAX_PLATFORMS) — for smoke runs or when the accelerator "
+    "relay is unavailable.",
+)
+@click.option(
     "--fused-optimizer/--no-fused-optimizer", default=None,
     help="Adam moments on one flat buffer (default: auto — on for pure "
     "data-parallel meshes). Pass --no-fused-optimizer to resume checkpoints "
@@ -75,9 +81,12 @@ def main(
     num_epochs, warmup_epochs, learning_rate, weight_decay, label_smoothing,
     clip_grad, grad_accum, augmentation, patch_size, backend, dtype, tp, fsdp,
     preset, checkpoint_dir, steps, num_train_images, num_eval_images,
-    fused_optimizer, seed,
+    platform, fused_optimizer, seed,
 ):
     import jax
+
+    if platform == "cpu":
+        jax.config.update("jax_platforms", "cpu")
 
     from sav_tpu.parallel import distributed_init
     from sav_tpu.train import TrainConfig, Trainer, get_preset

@@ -28,7 +28,7 @@ code path is testable on the CPU mesh.
 from __future__ import annotations
 
 import functools
-from typing import Optional
+from typing import NamedTuple, Optional
 
 import jax
 import jax.numpy as jnp
@@ -276,6 +276,74 @@ def _lanes(x: jax.Array, n: int) -> jax.Array:
     return jnp.broadcast_to(x[:, 0:1], (x.shape[0], n))
 
 
+class _BwdGeom(NamedTuple):
+    """Shared padded operands + geometry for the blocked backward drivers."""
+
+    qf: jax.Array
+    kf: jax.Array
+    vf: jax.Array
+    dof: jax.Array
+    delta: jax.Array
+    batch: int
+    heads: int
+    q_len: int
+    kv_len: int
+    dim: int
+    dim_p: int
+    block_q: int
+    block_kv: int
+    q_len_p: int
+    kv_len_p: int
+
+    def unprep(self, x: jax.Array, l: int) -> jax.Array:
+        """Padded ``[B·H, L_p, D_p]`` → ``[B, L, H, D]``."""
+        x = x[:, :l, : self.dim].reshape(self.batch, self.heads, l, self.dim)
+        return jnp.transpose(x, (0, 2, 1, 3))
+
+
+def _bwd_prep(q, k, v, out, g, block_q, block_kv) -> _BwdGeom:
+    """``[B, L, H, D]`` operands → the padded ``[B·H, L_p, D_p]`` layout both
+    blocked backward drivers consume, plus ``delta_i = Σ_d dO·O`` broadcast
+    across one lane tile (same layout as lse, so kernels read both with no
+    relayout). Single source for block clamping and padding geometry."""
+    batch, q_len, heads, dim = q.shape
+    kv_len = k.shape[1]
+    dim_p = _round_up(dim, 128)
+    block_q = min(block_q, _round_up(q_len, 16))
+    block_kv = min(block_kv, _round_up(kv_len, 16))
+    q_len_p = _round_up(q_len, block_q)
+    kv_len_p = _round_up(kv_len, block_kv)
+
+    def to_bhld(x):
+        b, l, h, d = x.shape
+        return jnp.transpose(x, (0, 2, 1, 3)).reshape(b * h, l, d)
+
+    def pad3(x, lp):
+        return jnp.pad(x, ((0, 0), (0, lp - x.shape[1]), (0, dim_p - x.shape[2])))
+
+    delta = jnp.sum(g.astype(jnp.float32) * out.astype(jnp.float32), axis=-1)
+    delta = jnp.transpose(delta, (0, 2, 1)).reshape(batch * heads, q_len)
+    delta = jnp.pad(delta, ((0, 0), (0, q_len_p - q_len)))
+    delta = jnp.broadcast_to(delta[:, :, None], delta.shape + (128,))
+    return _BwdGeom(
+        qf=pad3(to_bhld(q), q_len_p),
+        kf=pad3(to_bhld(k), kv_len_p),
+        vf=pad3(to_bhld(v), kv_len_p),
+        dof=pad3(to_bhld(g), q_len_p),
+        delta=delta,
+        batch=batch,
+        heads=heads,
+        q_len=q_len,
+        kv_len=kv_len,
+        dim=dim,
+        dim_p=dim_p,
+        block_q=block_q,
+        block_kv=block_kv,
+        q_len_p=q_len_p,
+        kv_len_p=kv_len_p,
+    )
+
+
 def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
                    dq_acc, *, scale: float, q_len: int, kv_len: int,
                    block_b: int, block_q: int, block_kv: int,
@@ -366,41 +434,18 @@ def _flash_backward_pallas(q, k, v, out, lse, g, scale, block_q, block_kv,
                            interpret):
     """Blocked backward; q/k/v/out/g are ``[B, L, H, D]``, lse is the padded
     ``[B·H, q_len_p, 128]`` forward residual."""
-    batch, q_len, heads, dim = q.shape
-    kv_len = k.shape[1]
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
 
-    def to_bhld(x):
-        b, l, h, d = x.shape
-        return jnp.transpose(x, (0, 2, 1, 3)).reshape(b * h, l, d)
-
-    dim_p = _round_up(dim, 128)
-    block_q = min(block_q, _round_up(q_len, 16))
-    block_kv = min(block_kv, _round_up(kv_len, 16))
-    q_len_p = _round_up(q_len, block_q)
-    kv_len_p = _round_up(kv_len, block_kv)
-
-    def pad3(x, lp):
-        return jnp.pad(x, ((0, 0), (0, lp - x.shape[1]), (0, dim_p - x.shape[2])))
-
-    qf = pad3(to_bhld(q), q_len_p)
-    kf = pad3(to_bhld(k), kv_len_p)
-    vf = pad3(to_bhld(v), kv_len_p)
-    dof = pad3(to_bhld(g), q_len_p)
-
-    # delta_i = Σ_d dO·O per query row, broadcast across one lane tile
-    # (same layout as lse so the kernels read both with no relayout).
-    delta = jnp.sum(
-        g.astype(jnp.float32) * out.astype(jnp.float32), axis=-1
-    )  # [B, Lq, H]
-    delta = jnp.transpose(delta, (0, 2, 1)).reshape(batch * heads, q_len)
-    delta = jnp.pad(delta, ((0, 0), (0, q_len_p - q_len)))
-    delta = jnp.broadcast_to(delta[:, :, None], delta.shape + (128,))
+    geom = _bwd_prep(q, k, v, out, g, block_q, block_kv)
+    qf, kf, vf, dof, delta = geom.qf, geom.kf, geom.vf, geom.dof, geom.delta
+    q_len, kv_len = geom.q_len, geom.kv_len
+    dim_p, block_q, block_kv = geom.dim_p, geom.block_q, geom.block_kv
+    q_len_p, kv_len_p = geom.q_len_p, geom.kv_len_p
 
     num_q_blocks = q_len_p // block_q
     num_kv_blocks = kv_len_p // block_kv
-    bh = batch * heads
+    bh = geom.batch * geom.heads
     block_b = _pick_block_b(bh)
 
     qspec = pl.BlockSpec((block_b, block_q, dim_p), lambda b, i, j: (b, i, 0))
@@ -454,11 +499,7 @@ def _flash_backward_pallas(q, k, v, out, lse, g, scale, block_q, block_kv,
         interpret=interpret,
     )(qf, kf, vf, dof, lse, delta)
 
-    def from_bhld(x, l):
-        x = x[:, :l, :dim].reshape(batch, heads, l, dim)
-        return jnp.transpose(x, (0, 2, 1, 3))
-
-    return from_bhld(dq, q_len), from_bhld(dk, kv_len), from_bhld(dv, kv_len)
+    return geom.unprep(dq, q_len), geom.unprep(dk, kv_len), geom.unprep(dv, kv_len)
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7))
@@ -885,28 +926,15 @@ def _rel_backward_pallas(q, k, v, rw_abs, rh_abs, out, lse, g, height, width,
     ``_flash_backward_pallas`` with the bias rebuilt in-kernel and its
     gradient reduced to the compact per-axis ``[B, H, L, W]/[B, H, L, H]``
     tables — ``[B,H,L,L]`` never materializes in either direction."""
-    batch, q_len, heads, dim = q.shape
-    kv_len = k.shape[1]
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
 
-    def to_bhld(x):
-        b, l, h, d = x.shape
-        return jnp.transpose(x, (0, 2, 1, 3)).reshape(b * h, l, d)
-
-    dim_p = _round_up(dim, 128)
-    block_q = min(block_q, _round_up(q_len, 16))
-    block_kv = min(block_kv, _round_up(kv_len, 16))
-    q_len_p = _round_up(q_len, block_q)
-    kv_len_p = _round_up(kv_len, block_kv)
-
-    def pad3(x, lp):
-        return jnp.pad(x, ((0, 0), (0, lp - x.shape[1]), (0, dim_p - x.shape[2])))
-
-    qf = pad3(to_bhld(q), q_len_p)
-    kf = pad3(to_bhld(k), kv_len_p)
-    vf = pad3(to_bhld(v), kv_len_p)
-    dof = pad3(to_bhld(g), q_len_p)
+    geom = _bwd_prep(q, k, v, out, g, block_q, block_kv)
+    qf, kf, vf, dof, delta = geom.qf, geom.kf, geom.vf, geom.dof, geom.delta
+    q_len, kv_len = geom.q_len, geom.kv_len
+    dim_p, block_q, block_kv = geom.dim_p, geom.block_q, geom.block_kv
+    q_len_p, kv_len_p = geom.q_len_p, geom.kv_len_p
+    batch, heads = geom.batch, geom.heads
 
     def prep_compact(c):
         bb, hh, ll, rr = c.shape
@@ -917,11 +945,6 @@ def _rel_backward_pallas(q, k, v, rw_abs, rh_abs, out, lse, g, height, width,
 
     rwf, rhf = prep_compact(rw_abs), prep_compact(rh_abs)
     wp, hp = rwf.shape[-1], rhf.shape[-1]
-
-    delta = jnp.sum(g.astype(jnp.float32) * out.astype(jnp.float32), axis=-1)
-    delta = jnp.transpose(delta, (0, 2, 1)).reshape(batch * heads, q_len)
-    delta = jnp.pad(delta, ((0, 0), (0, q_len_p - q_len)))
-    delta = jnp.broadcast_to(delta[:, :, None], delta.shape + (128,))
 
     num_q_blocks = q_len_p // block_q
     num_kv_blocks = kv_len_p // block_kv
@@ -991,19 +1014,15 @@ def _rel_backward_pallas(q, k, v, rw_abs, rh_abs, out, lse, g, height, width,
         interpret=interpret,
     )(qf, kf, vf, rwf, rhf, dof, lse, delta)
 
-    def from_bhld(x, l):
-        x = x[:, :l, :dim].reshape(batch, heads, l, dim)
-        return jnp.transpose(x, (0, 2, 1, 3))
-
     def from_compact(x, rr, ref):
         return x[:, :q_len, :rr].reshape(batch, heads, q_len, rr).astype(
             ref.dtype
         )
 
     return (
-        from_bhld(dq, q_len),
-        from_bhld(dk, kv_len),
-        from_bhld(dv, kv_len),
+        geom.unprep(dq, q_len),
+        geom.unprep(dk, kv_len),
+        geom.unprep(dv, kv_len),
         from_compact(drw, rw_abs.shape[-1], rw_abs),
         from_compact(drh, rh_abs.shape[-1], rh_abs),
     )

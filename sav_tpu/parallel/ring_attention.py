@@ -19,6 +19,7 @@ against the dense XLA core in ``tests/test_ring_attention.py``.
 from __future__ import annotations
 
 import functools
+import importlib
 from typing import Optional
 
 import jax
@@ -28,6 +29,11 @@ from jax.sharding import Mesh, PartitionSpec as P
 from sav_tpu.parallel._compat import shard_map
 
 from sav_tpu.parallel.mesh import SEQ_AXIS
+
+# importlib: `import ... as` and `from ... import` both resolve the
+# attribute `flash_attention`, which ops/__init__ rebinds to the same-named
+# function; sys.modules holds the real submodule.
+_fa = importlib.import_module("sav_tpu.ops.flash_attention")
 
 _NEG_INF = float("-inf")
 
@@ -65,6 +71,116 @@ def _ring_shard_fn(q, k, v, *, axis_name: str, axis_size: int, scale: float):
     return out.astype(q.dtype)
 
 
+# ---------------------------------------------------------------------------
+# Flash-mode ring: each ring step runs the fused Pallas kernel on the local
+# (Q, K_blk, V_blk) pair and the normalized partials are merged online with
+# their logsumexps — per-device memory stays O(L_loc·D + H·L_loc), never
+# O(L_loc²), in BOTH directions:
+#
+#   forward   o = Σ_i softmax-partial_i merged by lse_i (exact)
+#   backward  re-stream the ring with the GLOBAL lse: p_blk = exp(s − lse)
+#             is the globally-normalized probability block, so the blocked
+#             backward kernels yield dq partials (summed locally) and
+#             dk/dv partials that ride the ring home in carried f32
+#             accumulators (one full rotation returns them to their owner).
+#
+# Autodiff of the dense ring loop would instead save every per-step
+# [B,H,L_loc,L_loc] probability block — O(L_loc·L) per device. The
+# custom_vjp contains the ppermutes, so it composes with shard_map.
+# ---------------------------------------------------------------------------
+
+
+def _lse_to_padded(lse: jax.Array, q_len_p: int) -> jax.Array:
+    """``[B, H, Lq]`` f32 → the ``[B·H, q_len_p, 128]`` broadcast layout the
+    blocked backward kernels read."""
+    b, h, lq = lse.shape
+    flat = lse.reshape(b * h, lq)
+    flat = jnp.pad(flat, ((0, 0), (0, q_len_p - lq)))
+    return jnp.broadcast_to(flat[:, :, None], flat.shape + (128,))
+
+
+def _flash_ring_forward_steps(q, k, v, *, axis_name, axis_size, scale,
+                              block_q, block_kv, interpret):
+
+    batch, q_len, heads, dim = q.shape
+    acc = jnp.zeros((batch, q_len, heads, dim), jnp.float32)
+    m = jnp.full((batch, heads, q_len), _NEG_INF, jnp.float32)
+    denom = jnp.zeros((batch, heads, q_len), jnp.float32)
+
+    perm = [(j, (j + 1) % axis_size) for j in range(axis_size)]
+    for step in range(axis_size):
+        o_blk, lse_pad = _fa._flash_forward(
+            q, k, v, None, scale, block_q, block_kv, interpret, with_lse=True
+        )
+        lse_blk = lse_pad[:, :q_len, 0].reshape(batch, heads, q_len)
+        m_new = jnp.maximum(m, lse_blk)
+        w_old = jnp.exp(m - m_new)
+        w_blk = jnp.exp(lse_blk - m_new)
+        # weights are [B,H,Lq] → broadcast over the [B,Lq,H,D] partials.
+        to_q = lambda x: jnp.transpose(x, (0, 2, 1))[..., None]
+        acc = acc * to_q(w_old) + o_blk.astype(jnp.float32) * to_q(w_blk)
+        denom = denom * w_old + w_blk
+        m = m_new
+        if step + 1 < axis_size:
+            k = jax.lax.ppermute(k, axis_name, perm)
+            v = jax.lax.ppermute(v, axis_name, perm)
+
+    out = (acc / jnp.transpose(denom, (0, 2, 1))[..., None]).astype(q.dtype)
+    lse_global = m + jnp.log(denom)  # [B, H, Lq] f32
+    return out, lse_global
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8))
+def _ring_flash(q, k, v, axis_name, axis_size, scale, block_q, block_kv,
+                interpret):
+    out, _ = _flash_ring_forward_steps(
+        q, k, v, axis_name=axis_name, axis_size=axis_size, scale=scale,
+        block_q=block_q, block_kv=block_kv, interpret=interpret,
+    )
+    return out
+
+
+def _ring_flash_fwd(q, k, v, axis_name, axis_size, scale, block_q, block_kv,
+                    interpret):
+    out, lse = _flash_ring_forward_steps(
+        q, k, v, axis_name=axis_name, axis_size=axis_size, scale=scale,
+        block_q=block_q, block_kv=block_kv, interpret=interpret,
+    )
+    return out, (q, k, v, out, lse)
+
+
+def _ring_flash_bwd(axis_name, axis_size, scale, block_q, block_kv,
+                    interpret, residuals, g):
+
+    q, k, v, out, lse = residuals
+    batch, q_len, heads, dim = q.shape
+    block_q_eff = min(block_q, _fa._round_up(q_len, 16))
+    q_len_p = _fa._round_up(q_len, block_q_eff)
+    lse_pad = _lse_to_padded(lse, q_len_p)
+
+    dq = jnp.zeros(q.shape, jnp.float32)
+    dk = jnp.zeros(k.shape, jnp.float32)
+    dv = jnp.zeros(v.shape, jnp.float32)
+    perm = [(j, (j + 1) % axis_size) for j in range(axis_size)]
+    for _ in range(axis_size):
+        dq_p, dk_b, dv_b = _fa._flash_backward_pallas(
+            q, k, v, out, lse_pad, g, scale, block_q, block_kv, interpret
+        )
+        dq = dq + dq_p.astype(jnp.float32)
+        dk = dk + dk_b.astype(jnp.float32)
+        dv = dv + dv_b.astype(jnp.float32)
+        # Rotate K/V together with their gradient accumulators: after the
+        # full loop (axis_size rotations) each lands back on its owner.
+        k = jax.lax.ppermute(k, axis_name, perm)
+        v = jax.lax.ppermute(v, axis_name, perm)
+        dk = jax.lax.ppermute(dk, axis_name, perm)
+        dv = jax.lax.ppermute(dv, axis_name, perm)
+    return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
+
+
+_ring_flash.defvjp(_ring_flash_fwd, _ring_flash_bwd)
+
+
 def ring_attention(
     query: jax.Array,
     key: jax.Array,
@@ -74,6 +190,10 @@ def ring_attention(
     seq_axis: str = SEQ_AXIS,
     batch_axis: Optional[str] = None,
     scale: Optional[float] = None,
+    backend: str = "xla",
+    block_q: int = 512,
+    block_kv: int = 512,
+    interpret: Optional[bool] = None,
 ) -> jax.Array:
     """Exact attention over sequence-sharded inputs.
 
@@ -84,12 +204,19 @@ def ring_attention(
         unsharded host arrays also works (shard_map partitions them).
       mesh: mesh containing ``seq_axis`` (and optionally ``batch_axis``).
       scale: logits scale, default ``D ** -0.5``.
+      backend: ``'xla'`` — dense per-block logits (numerics reference);
+        ``'pallas'`` — each ring step runs the fused flash kernel and the
+        blocked backward re-streams the ring, so nothing O(L_loc²) exists
+        on any device in either direction (the configuration for truly
+        long contexts; see module comment).
 
     Returns:
       ``[B, L, H, D]``, sharded like the query.
     """
     if scale is None:
         scale = query.shape[-1] ** -0.5
+    if backend not in ("xla", "pallas"):
+        raise ValueError(f"unknown ring attention backend: {backend!r}")
     axis_size = mesh.shape[seq_axis]
     if query.shape[1] % axis_size:
         raise ValueError(
@@ -97,13 +224,25 @@ def ring_attention(
             f"{seq_axis}={axis_size}"
         )
     spec = P(batch_axis, seq_axis, None, None)
-    fn = shard_map(
-        functools.partial(
+    if backend == "pallas":
+        # positional args only: custom_vjp's nondiff_argnums handling
+        # rejects keywords.
+        fscale = float(scale)
+
+        def shard_fn(q, k, v):
+            return _ring_flash(
+                q, k, v, seq_axis, axis_size, fscale, block_q, block_kv,
+                interpret,
+            )
+    else:
+        shard_fn = functools.partial(
             _ring_shard_fn,
             axis_name=seq_axis,
             axis_size=axis_size,
             scale=float(scale),
-        ),
+        )
+    fn = shard_map(
+        shard_fn,
         mesh=mesh,
         in_specs=(spec, spec, spec),
         out_specs=spec,

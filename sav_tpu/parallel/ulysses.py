@@ -30,7 +30,8 @@ from sav_tpu.parallel._compat import shard_map
 from sav_tpu.parallel.mesh import SEQ_AXIS
 
 
-def _ulysses_shard_fn(q, k, v, *, axis_name: str, scale: float):
+def _ulysses_shard_fn(q, k, v, *, axis_name: str, scale: float,
+                      backend: str = "xla"):
     """Per-shard body. q/k/v: ``[B, L_loc, H, D]`` (sequence shards)."""
 
     def seq_to_heads(x):
@@ -46,13 +47,20 @@ def _ulysses_shard_fn(q, k, v, *, axis_name: str, scale: float):
         )
 
     q, k, v = seq_to_heads(q), seq_to_heads(k), seq_to_heads(v)
-    s = jnp.einsum(
-        "bqhd,bkhd->bhqk", q, k, preferred_element_type=jnp.float32
-    ) * scale
-    p = jax.nn.softmax(s, axis=-1).astype(v.dtype)
-    out = jnp.einsum(
-        "bhqk,bkhd->bqhd", p, v, preferred_element_type=jnp.float32
-    ).astype(q.dtype)
+    if backend == "pallas":
+        # Fused kernel (blocked fwd AND bwd) on the full-sequence head
+        # group: local memory stays O(L·D) — the long-context setting.
+        from sav_tpu.ops import flash_attention
+
+        out = flash_attention(q, k, v, scale=scale)
+    else:
+        s = jnp.einsum(
+            "bqhd,bkhd->bhqk", q, k, preferred_element_type=jnp.float32
+        ) * scale
+        p = jax.nn.softmax(s, axis=-1).astype(v.dtype)
+        out = jnp.einsum(
+            "bhqk,bkhd->bqhd", p, v, preferred_element_type=jnp.float32
+        ).astype(q.dtype)
     return heads_to_seq(out)
 
 
@@ -65,6 +73,7 @@ def ulysses_attention(
     seq_axis: str = SEQ_AXIS,
     batch_axis: Optional[str] = None,
     scale: Optional[float] = None,
+    backend: str = "xla",
 ) -> jax.Array:
     """Exact attention over sequence-sharded inputs via head all-to-all.
 
@@ -74,12 +83,17 @@ def ulysses_attention(
         should already be sharded ``P(batch_axis, seq_axis, None, None)``.
       mesh: mesh containing ``seq_axis`` (and optionally ``batch_axis``).
       scale: logits scale, default ``D ** -0.5``.
+      backend: ``'xla'`` (dense local core, numerics reference) or
+        ``'pallas'`` (fused flash kernel with blocked backward on the local
+        head group — O(L·D) local memory for long contexts).
 
     Returns:
       ``[B, L, H, D]``, sharded like the query.
     """
     if scale is None:
         scale = query.shape[-1] ** -0.5
+    if backend not in ("xla", "pallas"):
+        raise ValueError(f"unknown ulysses attention backend: {backend!r}")
     axis_size = mesh.shape[seq_axis]
     if query.shape[1] % axis_size:
         raise ValueError(
@@ -94,7 +108,8 @@ def ulysses_attention(
     spec = P(batch_axis, seq_axis, None, None)
     fn = shard_map(
         functools.partial(
-            _ulysses_shard_fn, axis_name=seq_axis, scale=float(scale)
+            _ulysses_shard_fn, axis_name=seq_axis, scale=float(scale),
+            backend=backend,
         ),
         mesh=mesh,
         in_specs=(spec, spec, spec),

@@ -125,17 +125,20 @@ class Trainer:
             try:
                 restored = self.checkpointer.restore_latest(state)
             except Exception as e:
-                # The most likely structure mismatch: the checkpoint was
-                # written with the other optimizer-state layout (per-leaf vs
-                # optax.flatten'd — TrainConfig.fused_optimizer). Point at
-                # the switch instead of surfacing a bare Orbax tree error.
-                raise RuntimeError(
-                    "checkpoint restore failed with a state-structure "
-                    "mismatch; if this checkpoint predates the flat-buffer "
-                    "optimizer (round 3), rerun with --no-fused-optimizer "
-                    "(TrainConfig.fused_optimizer=False) to keep the "
-                    "per-leaf Adam state layout"
-                ) from e
+                # Only attribute tree/structure mismatches to the optimizer
+                # layout switch (per-leaf vs optax.flatten'd Adam state —
+                # TrainConfig.fused_optimizer); other failures (corrupt
+                # checkpoint, I/O errors) re-raise untouched.
+                msg = str(e).lower()
+                if any(w in msg for w in ("structure", "tree", "pytree")):
+                    raise RuntimeError(
+                        "checkpoint restore failed with a state-structure "
+                        "mismatch; if this checkpoint predates the "
+                        "flat-buffer optimizer (round 3), rerun with "
+                        "--no-fused-optimizer (TrainConfig.fused_optimizer="
+                        "False) to keep the per-leaf Adam state layout"
+                    ) from e
+                raise
             if restored is not None:
                 return restored
         return state

@@ -83,3 +83,26 @@ def test_ulysses_bf16(devices):
     np.testing.assert_allclose(
         np.asarray(out, np.float32), np.asarray(ref, np.float32), atol=3e-2, rtol=3e-2
     )
+
+
+def test_ulysses_flash_backend_matches_dense(devices):
+    import numpy as np
+    from sav_tpu.parallel import create_mesh
+
+    mesh = create_mesh({"seq": 4}, devices=jax.devices()[:4])
+    ks = jax.random.split(jax.random.PRNGKey(0), 3)
+    q, k, v = (jax.random.normal(kk, (2, 128, 4, 32)) for kk in ks)
+    ref = xla_attention(q, k, v)
+    out = ulysses_attention(q, k, v, mesh=mesh, backend="pallas")
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5, rtol=2e-5)
+
+    def loss(fn):
+        return lambda q, k, v: jnp.sum(jnp.square(fn(q, k, v)))
+
+    gu = jax.grad(
+        loss(lambda q, k, v: ulysses_attention(q, k, v, mesh=mesh, backend="pallas")),
+        argnums=(0, 1, 2),
+    )(q, k, v)
+    gd = jax.grad(loss(xla_attention), argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(gu, gd):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-4, rtol=5e-4)

@@ -84,19 +84,22 @@ def _host_shard_range(
 
 
 def _distorted_bbox_crop_window(
-    image_bytes: "tf.Tensor", stateless_seed=None
+    image_bytes: "tf.Tensor", stateless_seed=None,
+    area_range: tuple = (0.08, 1.0),
 ) -> "tf.Tensor":
     """Inception-style random crop window on raw JPEG bytes
     (input_pipeline.py:479-497). With ``stateless_seed`` the draw is a pure
     function of the seed (``sample_distorted_bounding_box`` ignores the
-    graph-level seed, so replayable pipelines must use the stateless op)."""
+    graph-level seed, so replayable pipelines must use the stateless op).
+    ``area_range`` is the reference's hard-coded (0.08, 1.0); small-image
+    datasets want a gentler floor (timm's configurable ``scale``)."""
     shape = tf.image.extract_jpeg_shape(image_bytes)
     bbox = tf.constant([0.0, 0.0, 1.0, 1.0], shape=[1, 1, 4])
     kwargs = dict(
         bounding_boxes=bbox,
         min_object_covered=0.1,
         aspect_ratio_range=(3.0 / 4.0, 4.0 / 3.0),
-        area_range=(0.08, 1.0),
+        area_range=tuple(area_range),
         use_image_if_no_bounding_boxes=True,
     )
     if stateless_seed is not None:
@@ -136,19 +139,22 @@ def _resize_bicubic(image, image_size: int):
     return tf.cast(tf.clip_by_value(out, 0.0, 255.0), tf.uint8)
 
 
-def _train_preprocess(image_bytes, image_size: int, stateless_seed=None):
+def _train_preprocess(image_bytes, image_size: int, stateless_seed=None,
+                      area_range: tuple = (0.08, 1.0), random_flip: bool = True):
     if stateless_seed is None:
-        window = _distorted_bbox_crop_window(image_bytes)
+        window = _distorted_bbox_crop_window(image_bytes, area_range=area_range)
         image = _decode_crop(image_bytes, window)
-        image = tf.image.random_flip_left_right(image)
+        if random_flip:
+            image = tf.image.random_flip_left_right(image)
     else:
         window = _distorted_bbox_crop_window(
-            image_bytes, stateless_seed=stateless_seed
+            image_bytes, stateless_seed=stateless_seed, area_range=area_range
         )
         image = _decode_crop(image_bytes, window)
-        image = tf.image.stateless_random_flip_left_right(
-            image, seed=stateless_seed + tf.constant([0, 1], tf.int64)
-        )
+        if random_flip:
+            image = tf.image.stateless_random_flip_left_right(
+                image, seed=stateless_seed + tf.constant([0, 1], tf.int64)
+            )
     return _resize_bicubic(image, image_size)
 
 
@@ -282,6 +288,8 @@ def load(
     epoch_mode: bool = False,
     strict_determinism: bool = False,
     split_examples: Optional[int] = None,
+    crop_area_range: tuple = (0.08, 1.0),
+    random_flip: bool = True,
 ) -> Generator[dict, None, None]:
     """Build the input generator. See module docstring.
 
@@ -406,7 +414,8 @@ def load(
                     [base, tf.cast(example["_index"], tf.int64) * 2]
                 )
             image = _train_preprocess(
-                example["image_bytes"], image_size, stateless_seed=sseed
+                example["image_bytes"], image_size, stateless_seed=sseed,
+                area_range=crop_area_range, random_flip=random_flip,
             )
             if not aug_after_mix:
                 image = _augment(image)

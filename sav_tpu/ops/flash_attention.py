@@ -301,6 +301,19 @@ class _BwdGeom(NamedTuple):
         return jnp.transpose(x, (0, 2, 1, 3))
 
 
+def lse_padded_layout(lse: jax.Array, q_len: int, block_q: int) -> jax.Array:
+    """``[B, H, Lq]`` f32 logsumexp → the ``[B·H, q_len_p, 128]`` broadcast
+    residual layout the blocked backward kernels read. Uses the same block
+    clamping as :func:`_bwd_prep`, so external callers (e.g. the flash-mode
+    ring backward) stay in sync with the drivers' padding geometry."""
+    block_q = min(block_q, _round_up(q_len, 16))
+    q_len_p = _round_up(q_len, block_q)
+    b, h, lq = lse.shape
+    flat = lse.reshape(b * h, lq)
+    flat = jnp.pad(flat, ((0, 0), (0, q_len_p - lq)))
+    return jnp.broadcast_to(flat[:, :, None], flat.shape + (128,))
+
+
 def _bwd_prep(q, k, v, out, g, block_q, block_kv) -> _BwdGeom:
     """``[B, L, H, D]`` operands → the padded ``[B·H, L_p, D_p]`` layout both
     blocked backward drivers consume, plus ``delta_i = Σ_d dO·O`` broadcast

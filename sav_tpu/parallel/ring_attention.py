@@ -90,15 +90,6 @@ def _ring_shard_fn(q, k, v, *, axis_name: str, axis_size: int, scale: float):
 # ---------------------------------------------------------------------------
 
 
-def _lse_to_padded(lse: jax.Array, q_len_p: int) -> jax.Array:
-    """``[B, H, Lq]`` f32 → the ``[B·H, q_len_p, 128]`` broadcast layout the
-    blocked backward kernels read."""
-    b, h, lq = lse.shape
-    flat = lse.reshape(b * h, lq)
-    flat = jnp.pad(flat, ((0, 0), (0, q_len_p - lq)))
-    return jnp.broadcast_to(flat[:, :, None], flat.shape + (128,))
-
-
 def _flash_ring_forward_steps(q, k, v, *, axis_name, axis_size, scale,
                               block_q, block_kv, interpret):
 
@@ -153,10 +144,7 @@ def _ring_flash_bwd(axis_name, axis_size, scale, block_q, block_kv,
                     interpret, residuals, g):
 
     q, k, v, out, lse = residuals
-    batch, q_len, heads, dim = q.shape
-    block_q_eff = min(block_q, _fa._round_up(q_len, 16))
-    q_len_p = _fa._round_up(q_len, block_q_eff)
-    lse_pad = _lse_to_padded(lse, q_len_p)
+    lse_pad = _fa.lse_padded_layout(lse, q.shape[1], block_q)
 
     dq = jnp.zeros(q.shape, jnp.float32)
     dk = jnp.zeros(k.shape, jnp.float32)

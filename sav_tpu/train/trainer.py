@@ -128,9 +128,15 @@ class Trainer:
                 # Only attribute tree/structure mismatches to the optimizer
                 # layout switch (per-leaf vs optax.flatten'd Adam state —
                 # TrainConfig.fused_optimizer); other failures (corrupt
-                # checkpoint, I/O errors) re-raise untouched.
+                # checkpoint, I/O errors) re-raise untouched. Match the
+                # exception type AND an anchored phrase — a bare substring
+                # would false-positive on paths containing 'tree'.
                 msg = str(e).lower()
-                if any(w in msg for w in ("structure", "tree", "pytree")):
+                mismatch = isinstance(e, (ValueError, TypeError, KeyError)) and any(
+                    phrase in msg
+                    for phrase in ("tree structure", "pytree", "same structure")
+                )
+                if mismatch:
                     raise RuntimeError(
                         "checkpoint restore failed with a state-structure "
                         "mismatch; if this checkpoint predates the "

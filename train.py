@@ -63,6 +63,17 @@ import click
     help="Eval-split size for non-ImageNet TFRecord datasets.",
 )
 @click.option(
+    "--crop-min-area", type=click.FloatRange(0.0, 1.0, min_open=True),
+    default=0.08,
+    help="Lower bound of the Inception-crop area range (reference parity "
+    "0.08). Small-image datasets want a gentler floor, e.g. 0.5.",
+)
+@click.option(
+    "--train-flip/--no-train-flip", default=True,
+    help="Random horizontal flip in train preprocessing (off for datasets "
+    "with chirality, e.g. digits/text).",
+)
+@click.option(
     "--platform", type=click.Choice(["auto", "cpu"]), default="auto",
     help="'cpu' pins JAX to host CPU before backend init (the TPU plugin "
     "ignores JAX_PLATFORMS) — for smoke runs or when the accelerator "
@@ -81,7 +92,7 @@ def main(
     num_epochs, warmup_epochs, learning_rate, weight_decay, label_smoothing,
     clip_grad, grad_accum, augmentation, patch_size, backend, dtype, tp, fsdp,
     preset, checkpoint_dir, steps, num_train_images, num_eval_images,
-    platform, fused_optimizer, seed,
+    crop_min_area, train_flip, platform, fused_optimizer, seed,
 ):
     import jax
 
@@ -228,6 +239,8 @@ def main(
             transpose=config.transpose_images,
             bfloat16=dtype == "bfloat16",
             split_examples=num_train_images,
+            crop_area_range=(crop_min_area, 1.0),
+            random_flip=train_flip,
         )
 
     def eval_iter_fn():

@@ -83,6 +83,55 @@ def talking_heads_attention(
     return jnp.einsum("...hqk,...khd->...qhd", probs.astype(value.dtype), value)
 
 
+class _FusedQKVProj(nn.Module):
+    """Stacked QKV projection computed as three slice-of-param matmuls.
+
+    Parameter tree is byte-identical to
+    ``nn.DenseGeneral(features=(3, heads, head_ch), name=...)`` — kernel
+    ``[in, 3, H, D]``, bias ``[3, H, D]`` — so checkpoints interchange with
+    the declarative layout. The compute differs deliberately: a single
+    einsum to ``[B, L, 3, H, D]`` followed by *middle-axis activation
+    slices* makes XLA relayout every slice (~1.3 ms/layer at DeiT-S shapes,
+    profiled in PERF.md §5); slicing the small *parameter* on its
+    unsharded 3-axis instead and running one einsum per projection keeps
+    every activation in its natural ``[B, L, H, D]`` layout. The param
+    slices are also what Megatron-style tensor parallelism wants: the
+    ``to_qkv`` sharding rule places the H axis, which each per-projection
+    einsum preserves (no flatten of a sharded dim).
+    """
+
+    num_heads: int
+    head_ch: int
+    use_bias: bool = False
+    dtype: Dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x: jax.Array):
+        in_ch = x.shape[-1]
+        h, d = self.num_heads, self.head_ch
+        hd = h * d
+
+        def kernel_init(rng, shape, param_dtype):
+            # Match DenseGeneral: lecun_normal over the flattened
+            # (fan_in, prod(features)) matrix, reshaped to the tree shape.
+            flat = nn.initializers.lecun_normal()(rng, (in_ch, 3 * hd), param_dtype)
+            return flat.reshape(shape)
+
+        kernel = self.param("kernel", kernel_init, (in_ch, 3, h, d), jnp.float32)
+        kernel = kernel.astype(self.dtype)
+        xc = x.astype(self.dtype)
+        if self.use_bias:
+            bias = self.param(
+                "bias", nn.initializers.zeros_init(), (3, h, d), jnp.float32
+            ).astype(self.dtype)
+
+        def proj(t):
+            y = jnp.einsum("...i,ihd->...hd", xc, kernel[:, t])
+            return y + bias[t] if self.use_bias else y
+
+        return proj(0), proj(1), proj(2)
+
+
 class AttentionBlock(nn.Module):
     """Multi-head (cross-)attention with optional talking heads.
 
@@ -98,7 +147,8 @@ class AttentionBlock(nn.Module):
     attn_dropout_rate: float = 0.0
     out_dropout_rate: float = 0.0
     use_bias: bool = False
-    # One QKV matmul for self-attention (TPU perf). Changes the param tree
+    # Stacked QKV parameter for self-attention (one [in, 3, H, D] kernel —
+    # see _FusedQKVProj for how it is computed). Changes the param tree
     # (to_qkv instead of to_q/to_k/to_v) — set False for the reference's
     # three-projection layout if a checkpoint/repro needs it, and for any
     # cross-attention use (Q and K/V come from different inputs). The
@@ -126,22 +176,24 @@ class AttentionBlock(nn.Module):
             dtype=self.dtype,
         )
         if self.fused_qkv:
-            # Self-attention: one [in, 3·H·D] matmul instead of three
-            # [in, H·D] ones — bigger MXU tiles and the activations are
-            # read from HBM once. Same init distribution per column as
-            # three separate DenseGenerals (fan_in is identical).
+            # Self-attention: one stacked [in, 3, H, D] parameter, computed
+            # as per-projection einsums over its slices (_FusedQKVProj —
+            # avoids the activation-slice relayouts, keeps TP sharding).
+            # Same init distribution per column as three separate
+            # DenseGenerals (fan_in is identical).
             if inputs_q is not inputs_kv:
                 raise ValueError(
                     "fused_qkv=True projects Q, K and V from one input and is "
                     "only valid for self-attention; pass fused_qkv=False for "
                     "cross-attention (distinct inputs_q / inputs_kv)."
                 )
-            qkv = dense(features=(3, self.num_heads, head_ch), name="to_qkv")(
-                inputs_q
-            )
-            query, key, value = (
-                qkv[..., 0, :, :], qkv[..., 1, :, :], qkv[..., 2, :, :]
-            )
+            query, key, value = _FusedQKVProj(
+                num_heads=self.num_heads,
+                head_ch=head_ch,
+                use_bias=self.use_bias,
+                dtype=self.dtype,
+                name="to_qkv",
+            )(inputs_q)
         else:
             proj = functools.partial(
                 dense, features=(self.num_heads, head_ch)

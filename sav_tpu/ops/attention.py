@@ -9,11 +9,13 @@ Layout convention everywhere in this framework: ``[batch..., length, heads, head
 reference. The Pallas path transposes to ``[B*H, L, D]`` internally.
 
 ``backend``:
-  - ``'xla'``    — jnp/einsum path. Deterministic calls use
-                   :func:`xla_attention_fast` (identical forward, hand-written
-                   bf16-residual VJP — PERF.md §1); attention-dropout calls
-                   use plain autodiff. For exact autodiff reference gradients
-                   call :func:`xla_attention` directly.
+  - ``'xla'``    — jnp/einsum path, plain autodiff backward. Measured faster
+                   than the hand-written bf16-residual VJP on v5e (PERF.md
+                   §5: the custom_vjp boundary blocks XLA fusions worth more
+                   than the residual-traffic saving); the hand VJP remains
+                   available as :func:`xla_attention_fast` for
+                   memory-constrained cases (bf16 residual halves the saved
+                   probabilities' HBM footprint).
   - ``'pallas'`` — fused Pallas TPU flash-attention kernel
                    (:mod:`sav_tpu.ops.flash_attention`). Deterministic only
                    (attention dropout falls back to XLA).
@@ -52,6 +54,22 @@ def _on_tpu() -> bool:
 # beyond it the XLA path thrashes or OOMs while flash stays O(L·D).
 _AUTO_PALLAS_LOGITS_BYTES = 2 << 30
 
+# Process-wide default for the XLA path's softmax dtype. f32 is the safe
+# reference; bf16 halves the dominant HBM traffic of the [B, H, L, L]
+# logits/probability tensors (PERF.md §5 — the attention core is
+# bandwidth-bound, not FLOP-bound, at model-zoo shapes) at ~2⁻⁸ relative
+# logit precision. Set via :func:`set_default_logits_dtype` (the Trainer
+# does this from ``TrainConfig.attention_logits_dtype``) BEFORE any jit
+# tracing: the value is baked into traces at trace time, and already-cached
+# executables do not notice later changes.
+_DEFAULT_LOGITS_DTYPE = jnp.float32
+
+
+def set_default_logits_dtype(dtype) -> None:
+    """Set the process-wide softmax dtype for the XLA attention path."""
+    global _DEFAULT_LOGITS_DTYPE
+    _DEFAULT_LOGITS_DTYPE = jnp.dtype(dtype).type
+
 
 def _dense_logits_bytes(query, key) -> int:
     b, lq, h, _ = query.shape
@@ -68,7 +86,7 @@ def xla_attention(
     dropout_rate: float = 0.0,
     dropout_rng: Optional[jax.Array] = None,
     deterministic: bool = True,
-    logits_dtype=jnp.float32,
+    logits_dtype=None,
 ) -> jax.Array:
     """Reference attention core in pure XLA ops.
 
@@ -77,13 +95,17 @@ def xla_attention(
       key, value: ``[..., kv_len, heads, head_dim]``.
       bias: optional logits bias broadcastable to ``[..., heads, q_len, kv_len]``.
       scale: logit scale; defaults to ``head_dim ** -0.5`` (attention.py:39).
-      logits_dtype: dtype for softmax math; fp32 keeps bf16 runs stable.
+      logits_dtype: dtype for softmax math; None = the process default
+        (:func:`set_default_logits_dtype`, f32 unless configured). fp32
+        keeps bf16 runs stable; bf16 halves the L² HBM traffic.
 
     Returns:
       ``[..., q_len, heads, head_dim]`` in the query dtype.
     """
     if scale is None:
         scale = query.shape[-1] ** -0.5
+    if logits_dtype is None:
+        logits_dtype = _DEFAULT_LOGITS_DTYPE
     probs = _softmax_probs(query, key, bias, scale, logits_dtype)
     if dropout_rate > 0.0 and not deterministic:
         if dropout_rng is None:
@@ -236,8 +258,6 @@ def dot_product_attention(
                 "deterministic mode (attention dropout runs on the XLA path)"
             )
         return _flash.flash_attention(query, key, value, bias, scale=scale)
-    if not has_dropout:
-        return xla_attention_fast(query, key, value, bias, scale=scale)
     return xla_attention(
         query,
         key,

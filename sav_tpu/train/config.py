@@ -23,6 +23,11 @@ class TrainConfig:
     image_size: int = 224
     compute_dtype: str = "bfloat16"
     attention_backend: Optional[str] = None  # None=auto | 'xla' | 'pallas'
+    # Softmax dtype on the XLA attention path. None = float32 (reference
+    # numerics). 'bfloat16' halves the dominant [B,H,L,L] HBM traffic
+    # (PERF.md §5) at ~2⁻⁸ relative logit precision — accuracy-gate before
+    # relying on it for a paper-recipe run.
+    attention_logits_dtype: Optional[str] = None
 
     # Data
     global_batch_size: int = 1024

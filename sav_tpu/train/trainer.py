@@ -51,6 +51,13 @@ class Trainer:
         self.compute_dtype = (
             jnp.bfloat16 if config.compute_dtype == "bfloat16" else jnp.float32
         )
+        # Set unconditionally (None = f32) so one Trainer's bf16 setting
+        # can't leak into the next Trainer built in the same process. Must
+        # happen before any jit tracing of the model — the default is baked
+        # into traces at trace time.
+        from sav_tpu.ops.attention import set_default_logits_dtype
+
+        set_default_logits_dtype(config.attention_logits_dtype or "float32")
         self.model = (
             model
             if model is not None

@@ -1,0 +1,106 @@
+#!/usr/bin/env python
+"""A/B full train-step variants on the live chip to attribute perf deltas.
+
+Variants (any comma list via --variants):
+  base       — as-shipped defaults (plain-autodiff attention backward,
+               f32 logits, fused-optimizer auto)
+  fastvjp    — route the dispatcher's XLA branch through the hand-written
+               bf16-residual VJP (`xla_attention_fast`)
+  bf16logits — TrainConfig.attention_logits_dtype='bfloat16' (halved L²
+               softmax HBM traffic)
+  nofuse     — fused_optimizer=False
+
+Prints one line per variant: best/median step ms over N windows. Chip
+throughput drifts minute-to-minute (~2x, PERF.md §5) — re-run and compare
+best-of windows across orderings before trusting deltas under ~5%.
+"""
+
+from __future__ import annotations
+
+import argparse
+import statistics
+import time
+
+import jax
+
+
+def time_steps(trainer, batch, warmup=3, windows=4, steps=10):
+    state = trainer.init_state(0)
+    batch = trainer.shard_batch(batch)
+    step = trainer._train_step
+    rng = jax.random.PRNGKey(0)
+    for _ in range(warmup):
+        state, metrics = step(state, batch, rng)
+    jax.device_get(metrics["loss"])
+    times = []
+    for _ in range(windows):
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            state, metrics = step(state, batch, rng)
+        jax.device_get(metrics["loss"])
+        times.append((time.perf_counter() - t0) / steps * 1e3)
+    return min(times), statistics.median(times)
+
+
+def make_batch(bs, image_size):
+    from sav_tpu.data import synthetic_data_iterator
+
+    return next(
+        synthetic_data_iterator(
+            batch_size=bs, image_size=image_size, num_classes=1000, learnable=False
+        )
+    )
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--variants", default="base,fastvjp,bf16logits,nofuse")
+    p.add_argument("--batch-size", type=int, default=256)
+    p.add_argument("--model", default="deit_s_patch16")
+    args = p.parse_args()
+
+    from sav_tpu.train import TrainConfig, Trainer
+    from sav_tpu.ops import attention as att
+
+    known = {"base", "fastvjp", "bf16logits", "nofuse"}
+    variants = args.variants.split(",")
+    unknown = set(variants) - known
+    if unknown:
+        raise SystemExit(f"unknown variants {sorted(unknown)}; known: {sorted(known)}")
+
+    batch = make_batch(args.batch_size, 224)
+
+    orig_xla = att.xla_attention
+    for variant in variants:
+        att.xla_attention = orig_xla
+        if variant == "fastvjp":
+            att.xla_attention = (
+                lambda q, k, v, bias=None, *, scale=None, **kw: att.xla_attention_fast(
+                    q, k, v, bias, scale=scale
+                )
+            )
+        config = TrainConfig(
+            model_name=args.model,
+            num_classes=1000,
+            image_size=224,
+            compute_dtype="bfloat16",
+            attention_backend="xla",
+            # Trainer resets the process logits-dtype default from this
+            # field on construction — set it here, not via the module API.
+            attention_logits_dtype=(
+                "bfloat16" if variant == "bf16logits" else None
+            ),
+            global_batch_size=args.batch_size,
+            transpose_images=False,
+            clip_grad_norm=1.0,
+            fused_optimizer=False if variant == "nofuse" else None,
+            seed=0,
+        )
+        trainer = Trainer(config)
+        best, med = time_steps(trainer, batch)
+        print(f"{variant:10s} best {best:7.2f} ms  median {med:7.2f} ms", flush=True)
+    att.xla_attention = orig_xla
+
+
+if __name__ == "__main__":
+    main()

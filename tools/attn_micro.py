@@ -1,0 +1,101 @@
+#!/usr/bin/env python
+"""Microbenchmark attention variants at model-zoo shapes on the live chip.
+
+The relayed benchmark chip shows minute-scale ~2x throughput swings, so all
+variants are compiled up front and their timing windows are interleaved
+round-robin; per-variant results are the min across rounds (the
+hardware-capability number). Loop-carried dependencies thread both the
+primal input and the cotangent so XLA can neither hoist the op nor
+simplify the backward.
+"""
+
+from __future__ import annotations
+
+import argparse
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from sav_tpu.ops import attention as att
+from sav_tpu.ops.flash_attention import flash_attention as fl
+
+
+def make_loop(fn, args, iters):
+    @jax.jit
+    def loop(*a):
+        def body(carry, _):
+            q = a[0] + carry.astype(a[0].dtype)
+            out = fn(q, *a[1:])
+            return jnp.sum(out.astype(jnp.float32)) * 1e-30, None
+
+        tot, _ = jax.lax.scan(body, jnp.float32(0), None, length=iters)
+        return tot
+
+    jax.device_get(loop(*args))  # compile + warm
+    return lambda: jax.device_get(loop(*args))
+
+
+def grad_wrap(fn, cot):
+    def run(q, k, v):
+        out, vjp = jax.vjp(fn, q, k, v)
+        g = (cot + jnp.sum(out.astype(jnp.float32)) * 1e-30).astype(out.dtype)
+        dq, dk, dv = vjp(g)
+        return dq + dk + dv
+
+    return run
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument(
+        "--shapes",
+        default="256,197,6,64;64,785,6,64",
+        help="semicolon-separated B,L,H,D",
+    )
+    p.add_argument("--iters", type=int, default=20)
+    p.add_argument("--rounds", type=int, default=5)
+    args = p.parse_args()
+
+    rng = np.random.default_rng(0)
+    for spec in args.shapes.split(";"):
+        b, l, h, d = map(int, spec.split(","))
+        q, k, v = (
+            jnp.asarray(rng.standard_normal((b, l, h, d)), dtype=jnp.bfloat16)
+            for _ in range(3)
+        )
+        cot = jnp.asarray(rng.standard_normal((b, l, h, d)), dtype=jnp.float32)
+        variants = {
+            "xla-autodiff": lambda q, k, v: att.xla_attention(q, k, v),
+            "fast-vjp": lambda q, k, v: att.xla_attention_fast(q, k, v),
+            "pallas": lambda q, k, v: fl(q, k, v, block_q=256, block_kv=256),
+        }
+        print(f"== shape B={b} L={l} H={h} D={d}")
+        loops = {}
+        for name, fn in variants.items():
+            loops[f"{name} fwd"] = make_loop(fn, (q, k, v), args.iters)
+            loops[f"{name} fwd+bwd"] = make_loop(
+                grad_wrap(fn, cot), (q, k, v), args.iters
+            )
+        best = {k: float("inf") for k in loops}
+        names = list(loops)
+        for r in range(args.rounds):
+            # Rotate the order each round: relay throughput bursts/throttles
+            # on second scales, so a fixed order biases whoever runs first.
+            for name in names[r % len(names):] + names[: r % len(names)]:
+                t0 = time.perf_counter()
+                loops[name]()
+                best[name] = min(
+                    best[name], (time.perf_counter() - t0) / args.iters * 1e3
+                )
+        for name in variants:
+            print(
+                f"  {name:13s} fwd {best[f'{name} fwd']:7.2f} ms   "
+                f"fwd+bwd {best[f'{name} fwd+bwd']:7.2f} ms",
+                flush=True,
+            )
+
+
+if __name__ == "__main__":
+    main()

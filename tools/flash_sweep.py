@@ -1,0 +1,91 @@
+#!/usr/bin/env python
+"""Sweep flash-attention block configs at a given shape on the live chip."""
+
+from __future__ import annotations
+
+import argparse
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+import importlib
+
+# sav_tpu.ops.__init__ re-exports a *function* named flash_attention that
+# shadows the submodule on `from ... import`; go via sys.modules.
+flmod = importlib.import_module("sav_tpu.ops.flash_attention")
+
+
+def timed(fn, args, iters=20, windows=3):
+    @jax.jit
+    def loop(*a):
+        def body(carry, _):
+            q = a[0] + carry.astype(a[0].dtype)
+            out = fn(q, *a[1:])
+            return jnp.sum(out.astype(jnp.float32)) * 1e-30, None
+
+        tot, _ = jax.lax.scan(body, jnp.float32(0), None, length=iters)
+        return tot
+
+    jax.device_get(loop(*args))
+    times = []
+    for _ in range(windows):
+        t0 = time.perf_counter()
+        jax.device_get(loop(*args))
+        times.append((time.perf_counter() - t0) / iters * 1e3)
+    return min(times)
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--shape", default="256,197,6,64")
+    p.add_argument("--blocks", default="128,128;256,256;512,512")
+    p.add_argument("--block-b", default="4,8,16,32")
+    p.add_argument("--bwd", action="store_true")
+    args = p.parse_args()
+
+    b, l, h, d = map(int, args.shape.split(","))
+    rng = np.random.default_rng(0)
+    q, k, v = (
+        jnp.asarray(rng.standard_normal((b, l, h, d)), dtype=jnp.bfloat16)
+        for _ in range(3)
+    )
+    cot = jnp.asarray(rng.standard_normal((b, l, h, d)), dtype=jnp.float32)
+
+    orig_pick = flmod._pick_block_b
+    print(f"shape B={b} L={l} H={h} D={d}  (bh={b*h})")
+    for bq_bkv in args.blocks.split(";"):
+        bq, bkv = map(int, bq_bkv.split(","))
+        for bb in map(int, args.block_b.split(",")):
+            if (b * h) % bb != 0:
+                continue
+            flmod._pick_block_b = lambda bh, *, force_one=False, _bb=bb: (
+                1 if force_one else _bb
+            )
+            fn = lambda q, k, v: flmod.flash_attention(
+                q, k, v, block_q=bq, block_kv=bkv
+            )
+            try:
+                t = timed(fn, (q, k, v))
+                line = f"  bq={bq:4d} bkv={bkv:4d} bb={bb:3d}  fwd {t:7.2f} ms"
+                if args.bwd:
+                    def loss(q, k, v):
+                        return jnp.sum(fn(q, k, v).astype(jnp.float32) * cot)
+
+                    g = jax.grad(loss, argnums=(0, 1, 2))
+
+                    def run(q, k, v):
+                        dq, dk, dv = g(q, k, v)
+                        return dq + dk + dv
+
+                    tb = timed(run, (q, k, v))
+                    line += f"   fwd+bwd {tb:7.2f} ms"
+                print(line, flush=True)
+            except Exception as e:  # noqa: BLE001 - sweep keeps going
+                print(f"  bq={bq:4d} bkv={bkv:4d} bb={bb:3d}  FAIL {type(e).__name__}: {e}"[:120], flush=True)
+    flmod._pick_block_b = orig_pick
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,127 @@
+#!/usr/bin/env python
+"""Trace the DeiT-S train step and print device-op time by bucket.
+
+Runs N steady-state steps under jax.profiler.trace, parses the resulting
+xplane proto (TensorFlow's profiler schema), and aggregates device-plane op
+durations by HLO op name / fusion, so optimization targets are measured,
+not guessed.
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import gzip
+import os
+from collections import defaultdict
+
+
+def device_op_times(trace_json_gz):
+    """Sum complete-event durations per op name on the TPU device track."""
+    with gzip.open(trace_json_gz) as f:
+        tr = __import__("json").load(f)
+    events = tr["traceEvents"]
+    device_pids = {
+        e["pid"]
+        for e in events
+        if e.get("ph") == "M"
+        and e.get("name") == "process_name"
+        and "TPU" in e["args"].get("name", "")
+    }
+    totals = defaultdict(float)
+    counts = defaultdict(int)
+    for e in events:
+        if e.get("ph") == "X" and e.get("pid") in device_pids:
+            totals[e["name"]] += e.get("dur", 0) / 1e3  # us -> ms
+            counts[e["name"]] += 1
+    return totals, counts
+
+
+def bucket(name: str) -> str:
+    n = name.lower()
+    if "softmax" in n:
+        return "softmax"
+    if "transpose" in n:
+        return "transpose"
+    if "fusion" in n:
+        return "fusion(other)"
+    if "dot" in n or "conv" in n:
+        return "dot/conv"
+    if "copy" in n or "bitcast" in n:
+        return "copy/layout"
+    if "all-reduce" in n or "collective" in n:
+        return "collective"
+    return "other"
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--steps", type=int, default=5)
+    p.add_argument("--batch-size", type=int, default=256)
+    p.add_argument("--model", default="deit_s_patch16")
+    p.add_argument("--out", default="/tmp/step_trace")
+    p.add_argument("--top", type=int, default=40)
+    args = p.parse_args()
+
+    import jax
+
+    from sav_tpu.data import synthetic_data_iterator
+    from sav_tpu.train import TrainConfig, Trainer
+
+    config = TrainConfig(
+        model_name=args.model,
+        num_classes=1000,
+        image_size=224,
+        compute_dtype="bfloat16",
+        attention_backend="xla",
+        global_batch_size=args.batch_size,
+        transpose_images=False,
+        clip_grad_norm=1.0,
+        seed=0,
+    )
+    trainer = Trainer(config)
+    state = trainer.init_state(0)
+    batch = trainer.shard_batch(
+        next(
+            synthetic_data_iterator(
+                batch_size=args.batch_size,
+                image_size=224,
+                num_classes=1000,
+                learnable=False,
+            )
+        )
+    )
+    rng = jax.random.PRNGKey(0)
+    step = trainer._train_step
+    for _ in range(3):
+        state, metrics = step(state, batch, rng)
+    jax.device_get(metrics["loss"])
+
+    with jax.profiler.trace(args.out):
+        for _ in range(args.steps):
+            state, metrics = step(state, batch, rng)
+        jax.device_get(metrics["loss"])
+
+    traces = sorted(
+        glob.glob(os.path.join(args.out, "**", "*.trace.json.gz"), recursive=True),
+        key=os.path.getmtime,
+    )
+    if not traces:
+        raise SystemExit(f"no trace.json.gz under {args.out}")
+    totals, counts = device_op_times(traces[-1])
+
+    per_step = {k: v / args.steps for k, v in totals.items()}
+    total = sum(per_step.values())
+    print(f"device op time: {total:.1f} ms/step over {args.steps} steps")
+    buckets = defaultdict(float)
+    for k, v in per_step.items():
+        buckets[bucket(k)] += v
+    for k, v in sorted(buckets.items(), key=lambda kv: -kv[1]):
+        print(f"  {k:15s} {v:8.2f} ms/step")
+    print(f"\ntop {args.top} ops:")
+    for k, v in sorted(per_step.items(), key=lambda kv: -kv[1])[: args.top]:
+        print(f"  {v:8.3f} ms  x{counts[k]//args.steps:<4d} {k[:110]}")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,89 @@
+#!/usr/bin/env python
+"""Compare QKV projection+split strategies (fwd+bwd) on the live chip.
+
+  mid-slice  — einsum to [B,L,3,H,D], slice middle dim (current layer code)
+  lane-slice — flat [in,3HD] matmul to [B,L,3HD], lane-aligned last-dim
+               splits + free reshape to [B,L,H,D]
+  separate   — three [in,HD] matmuls (unfused baseline)
+
+Each variant feeds a dummy attention-ish consumer so the splits' layouts
+actually matter downstream.
+"""
+
+from __future__ import annotations
+
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+B, L, H, D = 256, 197, 6, 64
+IN = H * D
+rng = np.random.default_rng(0)
+x = jnp.asarray(rng.standard_normal((B, L, IN)), dtype=jnp.bfloat16)
+w5 = jnp.asarray(rng.standard_normal((IN, 3, H, D)) * 0.05, dtype=jnp.bfloat16)
+cot = jnp.asarray(rng.standard_normal((B, L, H, D)), dtype=jnp.float32)
+
+
+def consume(q, k, v):
+    # Dummy attention core so downstream layout matters.
+    logits = jnp.einsum("bqhd,bkhd->bhqk", q, k, preferred_element_type=jnp.float32)
+    p = jax.nn.softmax(logits, axis=-1).astype(v.dtype)
+    return jnp.einsum("bhqk,bkhd->bqhd", p, v)
+
+
+def mid_slice(x, w):
+    qkv = jnp.einsum("bli,ithd->blthd", x, w)
+    return consume(qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2])
+
+
+def lane_slice(x, w):
+    w2 = w.reshape(IN, 3 * H * D)
+    y = x @ w2  # [B, L, 3HD]
+    hd = H * D
+    q = y[..., :hd].reshape(B, L, H, D)
+    k = y[..., hd : 2 * hd].reshape(B, L, H, D)
+    v = y[..., 2 * hd :].reshape(B, L, H, D)
+    return consume(q, k, v)
+
+
+def separate(x, w):
+    q = jnp.einsum("bli,ihd->blhd", x, w[:, 0])
+    k = jnp.einsum("bli,ihd->blhd", x, w[:, 1])
+    v = jnp.einsum("bli,ihd->blhd", x, w[:, 2])
+    return consume(q, k, v)
+
+
+def make_loop(fn, iters=20):
+    def run(x, w):
+        out, vjp = jax.vjp(fn, x, w)
+        g = (cot + jnp.sum(out.astype(jnp.float32)) * 1e-30).astype(out.dtype)
+        dx, dw = vjp(g)
+        return jnp.sum(dx.astype(jnp.float32)) + jnp.sum(dw.astype(jnp.float32))
+
+    @jax.jit
+    def loop(x, w):
+        def body(carry, _):
+            xi = x + carry.astype(x.dtype)
+            return run(xi, w) * 1e-30, None
+
+        tot, _ = jax.lax.scan(body, jnp.float32(0), None, length=iters)
+        return tot
+
+    jax.device_get(loop(x, w5))
+    return lambda: jax.device_get(loop(x, w5))
+
+
+variants = {"mid-slice": mid_slice, "lane-slice": lane_slice, "separate": separate}
+loops = {k: make_loop(v) for k, v in variants.items()}
+best = {k: float("inf") for k in loops}
+names = list(loops)
+for r in range(6):
+    for name in names[r % len(names):] + names[: r % len(names)]:
+        t0 = time.perf_counter()
+        loops[name]()
+        best[name] = min(best[name], (time.perf_counter() - t0) / 20 * 1e3)
+for k, v in best.items():
+    print(f"{k:11s} fwd+bwd {v:7.3f} ms")

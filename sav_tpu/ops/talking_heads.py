@@ -14,10 +14,13 @@ relies on, so this kernel keeps **all heads of one batch element in a single
 grid cell** and mixes them in VMEM. CaiT's talking-heads trunk runs at short
 sequence lengths by design (196 tokens for the named CaiT configs), so the
 whole K/V fits one block and the softmax is exact row-wise — no online
-accumulation needed. The ``[B, H, L, L]`` logits therefore never exist in
-HBM on the forward pass; the backward is an XLA flash-style recompute (the
-head mixing makes the blocked backward a 4-way coupled system; dense
-recompute at ≤1k tokens is cheap and keeps numerics identical to autodiff).
+accumulation needed. The ``[B, H, L, L]`` logits never exist in HBM in
+either direction: the backward is also a blocked Pallas kernel
+(:func:`_th_bwd_kernel`) that recomputes S/P/P' in VMEM and resolves the
+4-way head-mix coupling with elementwise tile reductions for the ``[H, H]``
+gradients (no extra matmuls). Shapes beyond its VMEM budget
+(:func:`fused_bwd_eligible`) fall back to a dense XLA recompute with
+autodiff-identical numerics.
 
 The ``[H, H]`` mixing matrices ride in SMEM and are read as scalars.
 """
@@ -157,6 +160,203 @@ def _th_forward(q, k, v, w_pre, w_post, scale, block_q, interpret):
     return jnp.transpose(out, (0, 2, 1, 3))
 
 
+def fused_bwd_eligible(heads: int, q_len: int, kv_len: int, dim: int,
+                       block_q: int = _DEFAULT_BLOCK_Q) -> bool:
+    """Whether the blocked backward's larger VMEM working set fits.
+
+    The backward keeps ~6 per-head f32 logit-sized tiles live at once
+    (S, P, P', dP', dS', dS) plus Q/K/V/dO and the dk/dv accumulators —
+    stricter than the forward's 2. ``block_q`` is capped by ``q_len``
+    exactly as :func:`_th_backward` caps it, so the estimate tracks the
+    kernel's real tile size (a single-query class-attention call is far
+    cheaper than a square trunk call). Used by the backward dispatch so
+    shapes beyond the budget recompute on the XLA path instead."""
+    kv_len_p = _round_up(kv_len, 128)
+    dim_p = _round_up(dim, 128)
+    block_q = min(block_q, _round_up(q_len, 16))
+    logit_tiles = 6 * heads * block_q * kv_len_p * 4
+    qkv = 4 * heads * kv_len_p * dim_p * 2
+    accum = 2 * heads * kv_len_p * dim_p * 4
+    return logit_tiles + qkv + accum <= VMEM_BUDGET_BYTES
+
+
+def _th_bwd_kernel(q_ref, k_ref, v_ref, g_ref, wpre_ref, wpost_ref,
+                   dq_ref, dk_ref, dv_ref, dwpre_ref, dwpost_ref, *,
+                   heads: int, scale: float, kv_len: int, kv_len_p: int):
+    """Blocked talking-heads backward; one cell = all heads of one batch
+    element × one q block. No ``[B, H, L, L]`` tensor ever reaches HBM.
+
+    Recomputes S/P/P' flash-style from the q/k residuals, then:
+
+      dP'_i = dO_i·V_iᵀ                 dV_i += P'_iᵀ·dO_i
+      dWpost[h,i] += ⟨P_h, dP'_i⟩       dP_h = Σ_i Wpost[h,i]·dP'_i
+      dS'_i = P_i ⊙ (dP_i − rowsum(P_i⊙dP_i))
+      dWpre[h,i] += ⟨S_h, dS'_i⟩        dS_h = Σ_i Wpre[h,i]·dS'_i
+      dQ_h = scale·dS_h·K_h             dK_h += scale·dS_hᵀ·Q_h
+
+    The ⟨·,·⟩ head-mix gradients are elementwise VPU reductions (no
+    matmul), and every matmul runs storage-dtype-in / f32-accumulate on
+    the MXU. dk/dv/dW accumulate in their output blocks across the
+    (sequential, innermost) q-block grid axis."""
+    qi = pl.program_id(1)
+
+    @pl.when(qi == 0)
+    def _init():
+        dk_ref[...] = jnp.zeros_like(dk_ref)
+        dv_ref[...] = jnp.zeros_like(dv_ref)
+        dwpre_ref[...] = jnp.zeros_like(dwpre_ref)
+        dwpost_ref[...] = jnp.zeros_like(dwpost_ref)
+
+    col = None
+    # Recompute per-head raw logits (padded kv columns give exact 0 —
+    # K is zero-padded — matching the forward's pre-mix values).
+    s = []
+    for h in range(heads):
+        sh = jax.lax.dot_general(
+            q_ref[0, h], k_ref[0, h], (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ) * scale
+        s.append(sh)
+    if kv_len != kv_len_p:
+        col = jax.lax.broadcasted_iota(jnp.int32, s[0].shape, 1)
+
+    probs = []
+    for i in range(heads):
+        mixed = s[0] * wpre_ref[0, i]
+        for h in range(1, heads):
+            mixed += s[h] * wpre_ref[h, i]
+        if col is not None:
+            mixed = jnp.where(col < kv_len, mixed, _NEG_INF)
+        m = jnp.max(mixed, axis=-1, keepdims=True)
+        p = jnp.exp(mixed - m)
+        probs.append(p / jnp.sum(p, axis=-1, keepdims=True))
+
+    # dP' and dV per output head; dWpost from direct tile reductions.
+    dpost = []
+    for i in range(heads):
+        g = g_ref[0, i]
+        vi = v_ref[0, i]
+        dpi = jax.lax.dot_general(  # dO_i · V_iᵀ : [bq, Lkv]
+            g, vi, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        dpost.append(dpi)
+        post = probs[0] * wpost_ref[0, i]
+        for h in range(1, heads):
+            post += probs[h] * wpost_ref[h, i]
+        dv_ref[0, i] += jax.lax.dot_general(  # P'_iᵀ · dO_i : [Lkv, D]
+            post.astype(g.dtype), g, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        for h in range(heads):
+            dwpost_ref[0, h, i] += jnp.sum(probs[h] * dpi)
+
+    # Softmax backward per head, then the pre-mix couplings.
+    ds_mixed = []
+    for i in range(heads):
+        dp = dpost[0] * wpost_ref[i, 0]
+        for j in range(1, heads):
+            dp += dpost[j] * wpost_ref[i, j]
+        pi = probs[i]
+        ds = pi * (dp - jnp.sum(pi * dp, axis=-1, keepdims=True))
+        ds_mixed.append(ds)
+        for h in range(heads):
+            dwpre_ref[0, h, i] += jnp.sum(s[h] * ds)
+
+    for h in range(heads):
+        dsh = ds_mixed[0] * wpre_ref[h, 0]
+        for i in range(1, heads):
+            dsh += ds_mixed[i] * wpre_ref[h, i]
+        dsh_lo = dsh.astype(k_ref.dtype)
+        dq_ref[0, h] = (
+            jax.lax.dot_general(
+                dsh_lo, k_ref[0, h], (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            ) * scale
+        ).astype(dq_ref.dtype)
+        dk_ref[0, h] += (
+            jax.lax.dot_general(
+                dsh_lo, q_ref[0, h], (((0,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            ) * scale
+        )
+
+
+def _th_backward(q, k, v, w_pre, w_post, g, scale, block_q, interpret):
+    """Pallas-call wrapper for the blocked backward. Layouts as forward."""
+    batch, q_len, heads, dim = q.shape
+    kv_len = k.shape[1]
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+
+    def to_bhld(x):
+        return jnp.transpose(x, (0, 2, 1, 3))
+
+    dim_p = _round_up(dim, 128)
+    block_q = min(block_q, _round_up(q_len, 16))
+    q_len_p = _round_up(q_len, block_q)
+    kv_len_p = _round_up(kv_len, 128)
+
+    def pad4(x, lp):
+        return jnp.pad(
+            x, ((0, 0), (0, 0), (0, lp - x.shape[2]), (0, dim_p - x.shape[3]))
+        )
+
+    qf = pad4(to_bhld(q), q_len_p)
+    kf = pad4(to_bhld(k), kv_len_p)
+    vf = pad4(to_bhld(v), kv_len_p)
+    # Zero-padded cotangent rows make the padded q rows contribute exact
+    # zeros to dk/dv/dW (their dP' and dS' rows vanish).
+    gf = pad4(to_bhld(g.astype(q.dtype)), q_len_p)
+
+    num_q_blocks = q_len_p // block_q
+    kernel = functools.partial(
+        _th_bwd_kernel,
+        heads=heads,
+        scale=scale,
+        kv_len=kv_len,
+        kv_len_p=kv_len_p,
+    )
+    whole = lambda b, i: (b, 0, 0, 0)
+    dq, dk, dv, dwpre, dwpost = pl.pallas_call(
+        kernel,
+        grid=(batch, num_q_blocks),
+        in_specs=[
+            pl.BlockSpec((1, heads, block_q, dim_p), lambda b, i: (b, 0, i, 0)),
+            pl.BlockSpec((1, heads, kv_len_p, dim_p), whole),
+            pl.BlockSpec((1, heads, kv_len_p, dim_p), whole),
+            pl.BlockSpec((1, heads, block_q, dim_p), lambda b, i: (b, 0, i, 0)),
+            pl.BlockSpec(memory_space=pltpu.SMEM),
+            pl.BlockSpec(memory_space=pltpu.SMEM),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, heads, block_q, dim_p), lambda b, i: (b, 0, i, 0)),
+            pl.BlockSpec((1, heads, kv_len_p, dim_p), whole),
+            pl.BlockSpec((1, heads, kv_len_p, dim_p), whole),
+            pl.BlockSpec((1, heads, heads), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((1, heads, heads), lambda b, i: (b, 0, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((batch, heads, q_len_p, dim_p), q.dtype),
+            jax.ShapeDtypeStruct((batch, heads, kv_len_p, dim_p), jnp.float32),
+            jax.ShapeDtypeStruct((batch, heads, kv_len_p, dim_p), jnp.float32),
+            jax.ShapeDtypeStruct((batch, heads, heads), jnp.float32),
+            jax.ShapeDtypeStruct((batch, heads, heads), jnp.float32),
+        ],
+        interpret=interpret,
+    )(qf, kf, vf, gf, w_pre.astype(jnp.float32), w_post.astype(jnp.float32))
+
+    def from_bhld(x, l):
+        return jnp.transpose(x[:, :, :l, :dim], (0, 2, 1, 3))
+
+    dq = from_bhld(dq, q_len)
+    dk = from_bhld(dk, kv_len).astype(k.dtype)
+    dv = from_bhld(dv, kv_len).astype(v.dtype)
+    dwpre = jnp.sum(dwpre, axis=0).astype(w_pre.dtype)
+    dwpost = jnp.sum(dwpost, axis=0).astype(w_post.dtype)
+    return dq, dk, dv, dwpre, dwpost
+
+
 def _th_dense_reference(q, k, v, w_pre, w_post, scale):
     """Dense XLA talking-heads attention (backward recompute + numerics
     cross-check). Mirrors sav_tpu.models.layers.attention.talking_heads_attention."""
@@ -185,6 +385,12 @@ def _th_fwd(q, k, v, w_pre, w_post, scale, block_q, interpret):
 
 def _th_bwd(scale, block_q, interpret, residuals, g):
     q, k, v, w_pre, w_post = residuals
+    heads, dim = q.shape[2], q.shape[3]
+    if fused_bwd_eligible(heads, q.shape[1], k.shape[1], dim, block_q):
+        return _th_backward(q, k, v, w_pre, w_post, g, scale, block_q, interpret)
+    # Shapes beyond the backward's VMEM budget: dense XLA recompute
+    # (numerics identical to autodiff; the [B,H,L,L] cost returns, but
+    # only where the blocked kernel cannot run).
     _, vjp = jax.vjp(
         lambda q, k, v, wp, wq: _th_dense_reference(q, k, v, wp, wq, scale),
         q, k, v, w_pre, w_post,

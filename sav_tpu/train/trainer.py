@@ -91,9 +91,34 @@ class Trainer:
             self.checkpointer = Checkpointer(
                 config.checkpoint_dir, keep=config.checkpoint_keep
             )
-        self._train_step = jax.jit(self._train_step_impl, donate_argnums=(0,))
-        self._train_many = jax.jit(self._train_many_impl, donate_argnums=(0,))
-        self._eval_step = jax.jit(self._eval_step_impl)
+        self._train_step = self._pin_logits_dtype(
+            jax.jit(self._train_step_impl, donate_argnums=(0,))
+        )
+        self._train_many = self._pin_logits_dtype(
+            jax.jit(self._train_many_impl, donate_argnums=(0,))
+        )
+        self._eval_step = self._pin_logits_dtype(jax.jit(self._eval_step_impl))
+
+    def _pin_logits_dtype(self, jitted):
+        """Re-assert this trainer's softmax dtype before every call/lower.
+
+        The dtype lives in a process-wide default that another Trainer in
+        the same process may have changed; tracing is lazy, so without this
+        a step first traced *after* that change would silently bake in the
+        other trainer's dtype. Exposes ``lower`` for the AOT paths."""
+        dtype = self.config.attention_logits_dtype or "float32"
+        from sav_tpu.ops.attention import set_default_logits_dtype
+
+        def call(*args, **kwargs):
+            set_default_logits_dtype(dtype)
+            return jitted(*args, **kwargs)
+
+        def lower(*args, **kwargs):
+            set_default_logits_dtype(dtype)
+            return jitted.lower(*args, **kwargs)
+
+        call.lower = lower
+        return call
 
     # ------------------------------------------------------------------ init
 

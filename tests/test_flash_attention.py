@@ -211,6 +211,36 @@ def test_talking_heads_fused_gradients_match_dense():
         np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=5e-5, rtol=5e-4)
 
 
+def test_talking_heads_blocked_backward_multi_qblock():
+    """block_q < q_len drives the backward's dk/dv/dW accumulation across
+    sequential q-block grid cells (and the zero-padded final block)."""
+    from sav_tpu.ops.talking_heads import (
+        _th_dense_reference,
+        flash_talking_heads_attention,
+        fused_bwd_eligible,
+    )
+
+    assert fused_bwd_eligible(heads=3, q_len=40, kv_len=40, dim=16, block_q=16)
+    q, k, v = _qkv(lq=40, lk=40, h=3, d=16)
+    ks = jax.random.split(jax.random.PRNGKey(7), 2)
+    w_pre = jax.nn.initializers.orthogonal()(ks[0], (3, 3))
+    w_post = jax.nn.initializers.orthogonal()(ks[1], (3, 3))
+
+    def loss(fn):
+        return lambda *a: jnp.sum(jnp.square(fn(*a)))
+
+    gf = jax.grad(
+        loss(lambda *a: flash_talking_heads_attention(*a, block_q=16)),
+        argnums=(0, 1, 2, 3, 4),
+    )(q, k, v, w_pre, w_post)
+    gx = jax.grad(
+        loss(lambda *a: _th_dense_reference(*a, 16 ** -0.5)),
+        argnums=(0, 1, 2, 3, 4),
+    )(q, k, v, w_pre, w_post)
+    for a, b in zip(gf, gx):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=5e-5, rtol=5e-4)
+
+
 def test_talking_heads_fused_rejects_over_budget_shapes():
     from sav_tpu.ops.talking_heads import (
         flash_talking_heads_attention,

@@ -74,11 +74,19 @@ def main():
     for variant in variants:
         att.xla_attention = orig_xla
         if variant == "fastvjp":
-            att.xla_attention = (
-                lambda q, k, v, bias=None, *, scale=None, **kw: att.xla_attention_fast(
-                    q, k, v, bias, scale=scale
-                )
-            )
+
+            def _fastvjp(q, k, v, bias=None, *, scale=None, dropout_rate=0.0,
+                         deterministic=True, **kw):
+                # xla_attention_fast has no dropout support — refuse rather
+                # than silently time a cheaper computation than base.
+                if dropout_rate > 0.0 and not deterministic:
+                    raise ValueError(
+                        "fastvjp A/B variant cannot benchmark attention "
+                        "dropout configs"
+                    )
+                return att.xla_attention_fast(q, k, v, bias, scale=scale)
+
+            att.xla_attention = _fastvjp
         config = TrainConfig(
             model_name=args.model,
             num_classes=1000,

@@ -241,6 +241,57 @@ def test_train_iterator_resume_replays_epoch(recfile):
     ds.close()
 
 
+def test_tfrecords_to_savrec_converter(tmp_path):
+    """tools/tfrecords_to_savrec.py: ImageNet-layout TFRecords (JPEG bytes +
+    label) convert to a SavRecord the native loader reads back, labels
+    intact and pixels within JPEG+resize tolerance of the source."""
+    tf = pytest.importorskip("tensorflow")
+    import sys
+
+    rng = np.random.default_rng(3)
+    n, size = 5, 16
+    # Smooth gradients, not noise: JPEG mangles white noise even at q100,
+    # which would test the codec rather than the converter.
+    ramp = np.linspace(0, 255, size)
+    base = ramp[None, :, None] * 0.5 + ramp[None, None, :] * 0.5  # [1,H,W]
+    phase = rng.uniform(0, 100, (n, 1, 1, 3))
+    images = np.clip(base[..., None] * 0.8 + phase, 0, 255).astype(np.uint8)
+    labels = rng.integers(0, 10, (n,), dtype=np.int64)
+    tfr = str(tmp_path / "train-00000")
+    with tf.io.TFRecordWriter(tfr) as w:
+        for img, lab in zip(images, labels):
+            ex = tf.train.Example(
+                features=tf.train.Features(
+                    feature={
+                        "image/encoded": tf.train.Feature(
+                            bytes_list=tf.train.BytesList(
+                                value=[tf.io.encode_jpeg(img, quality=100).numpy()]
+                            )
+                        ),
+                        "image/class/label": tf.train.Feature(
+                            int64_list=tf.train.Int64List(value=[int(lab)])
+                        ),
+                    }
+                )
+            )
+            w.write(ex.SerializeToString())
+
+    out = str(tmp_path / "train.savrec")
+    proc = subprocess.run(
+        [sys.executable, str(REPO / "tools" / "tfrecords_to_savrec.py"),
+         "--tfrecords", tfr, "--out", out, "--image-size", str(size)],
+        capture_output=True, text=True,
+    )
+    assert proc.returncode == 0, f"converter failed:\n{proc.stderr}"
+    ds = SavRecDataset(out)
+    assert len(ds) == n
+    batch = ds.read_batch(np.arange(n))
+    np.testing.assert_array_equal(batch["labels"], labels.astype(np.int32))
+    # Same size in/out -> resize is ~identity; only JPEG quantization remains.
+    err = np.abs(batch["images"].astype(np.int32) - images.astype(np.int32))
+    assert np.median(err) <= 12, f"median pixel error {np.median(err)}"
+
+
 def test_fallback_validates_corruption_too(tmp_path, recfile, monkeypatch):
     from sav_tpu.data import native_loader as nl
 

@@ -339,3 +339,29 @@ def test_fused_optimizer_matches_per_leaf():
         pf,
         pp,
     )
+
+
+def test_logits_dtype_isolated_between_trainers(devices):
+    """A trainer's softmax dtype must not leak into another trainer's
+    lazily-traced steps: every step call re-asserts its own config's value
+    (trainer._pin_logits_dtype)."""
+    from sav_tpu.ops import attention as att
+
+    tr_f32 = _trainer(_smoke_config())
+    tr_bf16 = _trainer(_smoke_config(attention_logits_dtype="bfloat16"))
+    # Constructing the bf16 trainer set the process default to bf16; the
+    # f32 trainer's first (lazy) trace happens after that and must still
+    # bake in f32.
+    batch = {
+        "images": np.zeros((16, 32, 32, 3), np.float32),
+        "labels": np.arange(16) % 10,
+    }
+    state = tr_f32.init_state(0)
+    state, _ = tr_f32.train_step(state, batch, jax.random.PRNGKey(0))
+    assert att._DEFAULT_LOGITS_DTYPE == jnp.float32
+    state_b = tr_bf16.init_state(0)
+    tr_bf16.train_step(state_b, batch, jax.random.PRNGKey(0))
+    assert att._DEFAULT_LOGITS_DTYPE == jnp.bfloat16
+    # And back: the f32 trainer's next call restores its own setting.
+    tr_f32.eval_step(state, batch)
+    assert att._DEFAULT_LOGITS_DTYPE == jnp.float32

@@ -1,0 +1,105 @@
+#!/usr/bin/env python
+"""Convert ImageNet-layout TFRecords into the native SavRecord format.
+
+Bridges the standard TFRecord corpus (`image/encoded` JPEG bytes +
+`image/class/label`, the layout the tf.data path consumes) to the mmap'd
+fixed-shape SavRecord container served by the C++ gather in
+``native/records.cc`` — so the native loader path can train from real
+datasets, not just synthetic writes.
+
+SavRecord v1 stores decoded fixed-shape uint8, so decode policy must be
+chosen at conversion time: JPEGs are decoded and bicubic-resized to
+``--image-size`` squares (documented distortion; random-crop augmentation
+then happens at train time from these). Two passes keep memory O(chunk):
+count records, then decode into a disk-backed memmap that the SavRecord
+writer streams from.
+
+Usage:
+    python tools/tfrecords_to_savrec.py --tfrecords '.data/digits/train*' \
+        --out .data/digits/train.savrec --image-size 48
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import os
+import sys
+import tempfile
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--tfrecords", required=True, help="glob of TFRecord shards")
+    p.add_argument("--out", required=True, help="output .savrec path")
+    p.add_argument("--image-size", type=int, default=224)
+    p.add_argument("--label-offset", type=int, default=0,
+                   help="added to stored labels (some ImageNet TFRecords are 1-based: pass -1)")
+    args = p.parse_args()
+
+    import tensorflow as tf
+
+    from sav_tpu.data.records import write_savrec
+
+    files = sorted(glob.glob(args.tfrecords))
+    if not files:
+        raise SystemExit(f"no TFRecord files match {args.tfrecords!r}")
+
+    n = int(
+        tf.data.TFRecordDataset(files).reduce(
+            tf.constant(0, tf.int64), lambda c, _: c + 1
+        ).numpy()
+    )
+    print(f"{len(files)} shards, {n} records", flush=True)
+    if n == 0:
+        raise SystemExit(f"TFRecord files matching {args.tfrecords!r} hold 0 records")
+
+    size = args.image_size
+    feature_spec = {
+        "image/encoded": tf.io.FixedLenFeature([], tf.string),
+        "image/class/label": tf.io.FixedLenFeature([], tf.int64),
+    }
+
+    def parse_and_decode(raw):
+        ex = tf.io.parse_single_example(raw, feature_spec)
+        img = tf.io.decode_jpeg(ex["image/encoded"], channels=3)
+        img = tf.image.resize(
+            tf.cast(img, tf.float32), (size, size), method="bicubic"
+        )
+        img = tf.cast(tf.clip_by_value(tf.round(img), 0, 255), tf.uint8)
+        return img, tf.cast(ex["image/class/label"], tf.int32)
+
+    # Parallel decode through tf.data (ImageNet-scale conversion is decode
+    # bound; AUTOTUNE spreads it over the host cores), batched so the numpy
+    # boundary moves chunks, not single records.
+    ds = (
+        tf.data.TFRecordDataset(files)
+        .map(parse_and_decode, num_parallel_calls=tf.data.AUTOTUNE)
+        .batch(256)
+        .prefetch(tf.data.AUTOTUNE)
+    )
+
+    tmpdir = os.path.dirname(os.path.abspath(args.out)) or "."
+    with tempfile.NamedTemporaryFile(dir=tmpdir, suffix=".imgs.tmp") as tmp:
+        images = np.memmap(tmp.name, np.uint8, "w+", shape=(n, size, size, 3))
+        labels = np.empty((n,), np.int32)
+        i = 0
+        for img_b, lab_b in ds:
+            b = int(img_b.shape[0])
+            images[i : i + b] = img_b.numpy()
+            labels[i : i + b] = lab_b.numpy() + args.label_offset
+            i += b
+            if i % 25600 < 256:
+                print(f"  decoded {i}/{n}", flush=True)
+        assert i == n, f"decoded {i} records, counted {n}"
+        images.flush()
+        write_savrec(args.out, images, labels)
+    print(f"wrote {args.out} ({n} x {size}x{size}x3)", flush=True)
+
+
+if __name__ == "__main__":
+    main()

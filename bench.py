@@ -142,14 +142,17 @@ def run(model_name, batch_size, steps, backend, image_size, reps, feed):
             peak = per_chip_peak_flops()
             if peak:
                 result["mfu"] = round(flops / min(windows) / peak, 4)
-                # The img/s this chip could do at 100% of its *theoretical*
-                # peak — the physical ceiling of the benchmark hardware. The
-                # BASELINE north star (8,000 img/s/chip) was set for a TPU
-                # v4 part; when this bound is below the north star, no code
-                # on this chip can reach it and vs_baseline must be read
-                # against the bound.
+                # The img/s/chip this hardware could do at 100% of its
+                # *theoretical* peak — the physical ceiling of the benchmark
+                # chip. FLOPs are per-device and the batch is sharded, so
+                # the per-chip image share is batch/n_devices. The BASELINE
+                # north star (8,000 img/s/chip) was set for a TPU v4 part;
+                # when this bound is below the north star, no code on this
+                # chip can reach it and vs_baseline must be read against
+                # the bound.
+                per_chip_images = batch_size / len(jax.devices())
                 result["peak_bound_img_per_sec_per_chip"] = round(
-                    peak / (flops / batch_size), 1
+                    peak * per_chip_images / flops, 1
                 )
             result["step_flops_per_device"] = flops
     else:

@@ -38,6 +38,36 @@ def test_attention_cross():
     chex.assert_shape(out, (2, 5, 24))
 
 
+def test_fused_qkv_proj_equivalent_to_dense_general():
+    """_FusedQKVProj's param tree AND outputs must match the declarative
+    nn.DenseGeneral(features=(3, H, D)) formulation bit-for-bit given the
+    same rng — checkpoints written by either layout interchange."""
+    import flax.linen as nn
+
+    from sav_tpu.models.layers.attention import _FusedQKVProj
+
+    h, d, in_ch = 3, 8, 24
+    x = jax.random.normal(jax.random.PRNGKey(4), (2, 10, in_ch))
+
+    proj = _FusedQKVProj(num_heads=h, head_ch=d, use_bias=True, name="to_qkv")
+    dense = nn.DenseGeneral(
+        features=(3, h, d), axis=-1, use_bias=True, name="to_qkv"
+    )
+    p1 = proj.init(jax.random.PRNGKey(7), x)
+    p2 = dense.init(jax.random.PRNGKey(7), x)
+    chex.assert_trees_all_equal_shapes_and_dtypes(p1, p2)
+    jax.tree.map(np.testing.assert_array_equal, p1, p2)
+
+    q, k, v = proj.apply(p1, x)
+    packed = dense.apply(p1, x)
+    np.testing.assert_allclose(np.asarray(q), np.asarray(packed[..., 0, :, :]),
+                               atol=1e-5, rtol=1e-5)
+    np.testing.assert_allclose(np.asarray(k), np.asarray(packed[..., 1, :, :]),
+                               atol=1e-5, rtol=1e-5)
+    np.testing.assert_allclose(np.asarray(v), np.asarray(packed[..., 2, :, :]),
+                               atol=1e-5, rtol=1e-5)
+
+
 def test_attention_cross_with_fused_qkv_raises():
     """The QKV layout depends on the fused_qkv flag alone; cross-attention
     with fused_qkv=True is an explicit error, never a silent layout change."""

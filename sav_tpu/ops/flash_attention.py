@@ -585,8 +585,8 @@ def flash_attention(
     bias: Optional[jax.Array] = None,
     *,
     scale: Optional[float] = None,
-    block_q: int = 128,
-    block_kv: int = 128,
+    block_q: int = 256,
+    block_kv: int = 256,
     interpret: Optional[bool] = None,
 ) -> jax.Array:
     """Fused flash attention.
@@ -598,6 +598,8 @@ def flash_attention(
         ``[B, heads, q_len, kv_len]`` (e.g. BoTNet relative-position logits).
       scale: logit scale, default ``head_dim ** -0.5``.
       block_q / block_kv: VMEM tile sizes (clamped for short sequences).
+        Default 256: the v5e block sweep (tools/flash_sweep.py, PERF.md §5)
+        measured 256/256 ~1.6x faster than 128/128 at model-zoo shapes.
       interpret: force Pallas interpreter mode; default = auto (on for non-TPU).
 
     Returns:

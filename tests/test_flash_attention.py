@@ -68,25 +68,30 @@ def test_flash_gradients_match_xla():
 
 
 @pytest.mark.parametrize(
-    "lq,lk,d",
+    "lq,lk,d,blk",
     [
-        (197, 197, 64),  # DeiT-S/16 @ 224 — the flagship backward shape
-        (128, 128, 128),  # aligned
-        (50, 50, 32),  # unaligned: padded q rows + kv cols in both kernels
-        (1, 197, 64),  # class attention: single query row
-        (196, 49, 64),  # CvT: downsampled K/V
-        (320, 256, 40),  # multi-block q and kv, odd head dim
+        (197, 197, 64, None),  # DeiT-S/16 @ 224 — the flagship backward shape
+        (128, 128, 128, None),  # aligned
+        (50, 50, 32, None),  # unaligned: padded q rows + kv cols in both kernels
+        (1, 197, 64, None),  # class attention: single query row
+        (196, 49, 64, None),  # CvT: downsampled K/V
+        # Explicit 128 blocks: with the default 256 these lengths would be
+        # single-block, silently skipping the cross-block accumulation
+        # protocol (ki==0 init / last-ki write) this case exists to cover.
+        (320, 256, 40, 128),  # multi-block q and kv, odd head dim
     ],
 )
-def test_flash_blocked_backward_matches_xla(lq, lk, d):
+def test_flash_blocked_backward_matches_xla(lq, lk, d, blk):
     """No-bias gradients run the blocked Pallas backward kernels."""
     q, k, v = _qkv(lq=lq, lk=lk, d=d)
+    kw = {} if blk is None else {"block_q": blk, "block_kv": blk}
 
     def loss_f(fn):
-        return lambda q, k, v: jnp.sum(jnp.square(fn(q, k, v)))
+        return lambda q, k, v: jnp.sum(jnp.square(fn(q, k, v, **kw)))
 
     gf = jax.grad(loss_f(flash_attention), argnums=(0, 1, 2))(q, k, v)
-    gx = jax.grad(loss_f(xla_attention), argnums=(0, 1, 2))(q, k, v)
+    gx = jax.grad(loss_f(lambda q, k, v, **_: xla_attention(q, k, v)),
+                  argnums=(0, 1, 2))(q, k, v)
     for a, b in zip(gf, gx):
         np.testing.assert_allclose(
             np.asarray(a), np.asarray(b), atol=1e-4, rtol=5e-4

@@ -1,0 +1,128 @@
+#!/usr/bin/env python
+"""Accuracy gate for TrainConfig.attention_logits_dtype='bfloat16'.
+
+Trains the same ViT-Ti/8 twice on the in-memory digits dataset (identical
+seeds, batches, schedule) with f32 vs bf16 softmax, and reports the eval
+top-1 trajectory of each. The bf16 option halves the dominant [B,H,L,L]
+HBM traffic (PERF.md §5); this gate shows what it costs in accuracy on a
+real dataset before anyone relies on it for a paper-recipe run.
+"""
+
+from __future__ import annotations
+
+import argparse
+
+import numpy as np
+
+
+def load_digits_48():
+    from sklearn.datasets import load_digits
+
+    d = load_digits()
+    imgs = d.images.astype(np.float32)  # [N, 8, 8], 0..16
+    n = len(imgs)
+    rng = np.random.default_rng(0)
+    order = rng.permutation(n)
+    imgs, labels = imgs[order], d.target[order]
+    # upscale 8x8 -> 48x48 RGB by nearest-neighbor repeat, 0..255
+    up = np.repeat(np.repeat(imgs, 6, axis=1), 6, axis=2) * (255.0 / 16.0)
+    up = np.stack([up] * 3, axis=-1)
+    split = int(0.8 * n)
+    return (up[:split], labels[:split]), (up[split:], labels[split:])
+
+
+def run_variant(logits_dtype, steps, batch_size, eval_every):
+    import jax
+    import jax.numpy as jnp
+
+    from sav_tpu.train import TrainConfig, Trainer
+    from sav_tpu.utils.metrics import topk_correct
+
+    (xtr, ytr), (xev, yev) = load_digits_48()
+    mean = np.array([127.5, 127.5, 127.5], np.float32)
+    xtr = (xtr - mean) / 127.5
+    xev = (xev - mean) / 127.5
+
+    cfg = TrainConfig(
+        model_name="vit_ti_patch16",
+        num_classes=10,
+        image_size=48,
+        compute_dtype="float32",
+        attention_logits_dtype=logits_dtype,
+        attention_backend="xla",
+        global_batch_size=batch_size,
+        num_train_images=len(xtr),
+        num_epochs=max(1, steps * batch_size // len(xtr)),
+        warmup_epochs=1,
+        base_lr=2e-3,
+        lr_scaling_divisor=512,
+        transpose_images=False,
+        seed=42,
+    )
+    from sav_tpu.models import create_model
+
+    model = create_model(
+        cfg.model_name, num_classes=10, patch_shape=(8, 8), backend="xla"
+    )
+    tr = Trainer(cfg, model=model)
+    state = tr.init_state(0)
+    rng = np.random.default_rng(1)
+    jrng = jax.random.PRNGKey(0)
+    history = []
+    for step in range(1, steps + 1):
+        idx = rng.integers(0, len(xtr), batch_size)
+        batch = {
+            "images": jnp.asarray(xtr[idx]),
+            "labels": jnp.asarray(ytr[idx]),
+        }
+        state, m = tr.train_step(state, batch, jrng)
+        if step % eval_every == 0 or step == steps:
+            correct = 0
+            for lo in range(0, len(xev), batch_size):
+                xb = xev[lo : lo + batch_size]
+                yb = yev[lo : lo + batch_size]
+                logits = model.apply(
+                    {"params": state.params, **(
+                        {"batch_stats": state.batch_stats}
+                        if getattr(state, "batch_stats", None) else {}
+                    )},
+                    jnp.asarray(xb), is_training=False,
+                )
+                correct += int(
+                    topk_correct(logits, jnp.asarray(yb), topk=(1,))[
+                        "top_1_acc"
+                    ].sum()
+                )
+            acc = correct / len(xev)
+            history.append((step, float(m["loss"]), acc))
+            print(f"  [{logits_dtype or 'float32':8s}] step {step:4d} "
+                  f"loss {float(m['loss']):.3f} eval top-1 {acc*100:.1f}%",
+                  flush=True)
+    return history
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--steps", type=int, default=110)
+    p.add_argument("--batch-size", type=int, default=128)
+    p.add_argument("--eval-every", type=int, default=22)
+    args = p.parse_args()
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+    results = {}
+    for dtype in (None, "bfloat16"):
+        key = dtype or "float32"
+        print(f"== {key}", flush=True)
+        results[key] = run_variant(dtype, args.steps, args.batch_size,
+                                   args.eval_every)
+    f32 = results["float32"][-1][2]
+    bf16 = results["bfloat16"][-1][2]
+    print(f"\nfinal eval top-1: f32 {f32*100:.1f}%  bf16-logits {bf16*100:.1f}%  "
+          f"delta {(bf16-f32)*100:+.1f}pp", flush=True)
+
+
+if __name__ == "__main__":
+    main()

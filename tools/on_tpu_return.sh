@@ -6,8 +6,11 @@ cd /root/repo
 mkdir -p .tpu_results
 
 probe() {
+  # Must assert the device is a real TPU: if relay discovery fails (rather
+  # than hangs) JAX silently falls back to CPU and the matmul "succeeds".
   timeout 90 python -u -c "
 import jax, jax.numpy as jnp
+assert jax.devices()[0].platform != 'cpu', jax.devices()
 print(jax.device_get((jnp.ones((256,256),jnp.bfloat16)@jnp.ones((256,256),jnp.bfloat16)).sum()))
 " >/dev/null 2>&1
 }
@@ -22,7 +25,8 @@ run() {  # run <name> <timeout_s> <cmd...>
   local name=$1 t=$2; shift 2
   echo "$(date) START $name" >> .tpu_results/log
   timeout "$t" "$@" > ".tpu_results/$name.out" 2>&1
-  echo "$(date) DONE $name (rc=$?)" >> .tpu_results/log
+  local rc=$?
+  echo "$(date) DONE $name (rc=$rc)" >> .tpu_results/log
 }
 
 # 1. Mosaic compile + numerics check of the new talking-heads backward and

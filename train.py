@@ -44,6 +44,12 @@ import click
     "token grid stays meaningful at small resolutions).",
 )
 @click.option("--backend", type=click.Choice(["auto", "xla", "pallas"]), default="auto")
+@click.option(
+    "--logits-dtype", type=click.Choice(["float32", "bfloat16"]), default="float32",
+    help="Softmax dtype on the XLA attention path. bfloat16 halves the "
+    "[B,H,L,L] HBM traffic; accuracy-gated equal to f32 on the digits "
+    "recipe (tools/logits_dtype_gate.py, PERF.md §6).",
+)
 @click.option("--dtype", type=click.Choice(["bfloat16", "float32"]), default="bfloat16")
 @click.option("--tp", type=int, default=1, help="Tensor-parallel mesh axis size.")
 @click.option("--fsdp", type=int, default=1, help="FSDP mesh axis size (params sharded).")
@@ -90,9 +96,9 @@ import click
 def main(
     ctx, data_dir, fake_data, model_name, num_classes, image_size, batch_size,
     num_epochs, warmup_epochs, learning_rate, weight_decay, label_smoothing,
-    clip_grad, grad_accum, augmentation, patch_size, backend, dtype, tp, fsdp,
-    preset, checkpoint_dir, steps, num_train_images, num_eval_images,
-    crop_min_area, train_flip, platform, fused_optimizer, seed,
+    clip_grad, grad_accum, augmentation, patch_size, backend, logits_dtype,
+    dtype, tp, fsdp, preset, checkpoint_dir, steps, num_train_images,
+    num_eval_images, crop_min_area, train_flip, platform, fused_optimizer, seed,
 ):
     import jax
 
@@ -134,6 +140,9 @@ def main(
         image_size=image_size,
         compute_dtype=dtype,
         attention_backend=None if backend == "auto" else backend,
+        attention_logits_dtype=(
+            None if logits_dtype == "float32" else logits_dtype
+        ),
         global_batch_size=batch_size,
         augment=augmentation,
         num_epochs=num_epochs,
@@ -177,6 +186,10 @@ def main(
         }
         if "backend" in explicit:
             overrides["attention_backend"] = None if backend == "auto" else backend
+        if "logits_dtype" in explicit:
+            overrides["attention_logits_dtype"] = (
+                None if logits_dtype == "float32" else logits_dtype
+            )
         if mesh_axes is not None:
             overrides["mesh_axes"] = mesh_axes
         config = get_preset(preset, **overrides)

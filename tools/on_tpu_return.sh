@@ -76,4 +76,7 @@ run attn_micro 900 env PYTHONPATH=/root/repo:/root/.axon_site python tools/attn_
 # 5. bs-512 headline (img/s/chip may improve with larger per-chip batch).
 run bench_bs512 900 python bench.py --batch-size 512
 
+# 6. Talking-heads fused vs dense at the CaiT trunk shape.
+run th_micro 900 env PYTHONPATH=/root/repo:/root/.axon_site python tools/th_micro.py
+
 echo "$(date) battery complete" >> .tpu_results/log

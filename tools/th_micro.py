@@ -1,0 +1,108 @@
+#!/usr/bin/env python
+"""Talking-heads attention: fused kernel vs dense XLA, fwd and fwd+bwd.
+
+CaiT-shape microbenchmark with the same anti-hoisting/interleaving
+methodology as tools/attn_micro.py. Informs whether the layer's 'auto'
+dispatch should prefer the fused kernel for speed or only for memory.
+"""
+
+from __future__ import annotations
+
+import argparse
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from sav_tpu.ops.talking_heads import (
+    _th_dense_reference,
+    flash_talking_heads_attention,
+)
+
+
+def make_loop(fn, args, cot, iters):
+    def gradded(q, k, v, wp, wq):
+        out, vjp = jax.vjp(fn, q, k, v, wp, wq)
+        g = (cot + jnp.sum(out.astype(jnp.float32)) * 1e-30).astype(out.dtype)
+        dq, dk, dv, dwp, dwq = vjp(g)
+        return dq + dk + dv
+
+    @jax.jit
+    def loop(q, k, v, wp, wq):
+        def body(carry, _):
+            qi = q + carry.astype(q.dtype)
+            out = gradded(qi, k, v, wp, wq)
+            return jnp.sum(out.astype(jnp.float32)) * 1e-30, None
+
+        tot, _ = jax.lax.scan(body, jnp.float32(0), None, length=iters)
+        return tot
+
+    @jax.jit
+    def loop_fwd(q, k, v, wp, wq):
+        def body(carry, _):
+            qi = q + carry.astype(q.dtype)
+            out = fn(qi, k, v, wp, wq)
+            return jnp.sum(out.astype(jnp.float32)) * 1e-30, None
+
+        tot, _ = jax.lax.scan(body, jnp.float32(0), None, length=iters)
+        return tot
+
+    jax.device_get(loop_fwd(*args))
+    jax.device_get(loop(*args))
+    return (lambda: jax.device_get(loop_fwd(*args))), (
+        lambda: jax.device_get(loop(*args))
+    )
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--shape", default="256,197,4,48", help="B,L,H,D (CaiT-XXS)")
+    p.add_argument("--iters", type=int, default=20)
+    p.add_argument("--rounds", type=int, default=6)
+    args = p.parse_args()
+
+    b, l, h, d = map(int, args.shape.split(","))
+    rng = np.random.default_rng(0)
+    q, k, v = (
+        jnp.asarray(rng.standard_normal((b, l, h, d)), dtype=jnp.bfloat16)
+        for _ in range(3)
+    )
+    ks = jax.random.split(jax.random.PRNGKey(5), 2)
+    wp = jax.nn.initializers.orthogonal()(ks[0], (h, h))
+    wq = jax.nn.initializers.orthogonal()(ks[1], (h, h))
+    cot = jnp.asarray(rng.standard_normal((b, l, h, d)), dtype=jnp.float32)
+    scale = d ** -0.5
+
+    variants = {
+        "dense-xla": lambda q, k, v, wp, wq: _th_dense_reference(
+            q, k, v, wp, wq, scale
+        ),
+        "fused": lambda q, k, v, wp, wq: flash_talking_heads_attention(
+            q, k, v, wp, wq
+        ),
+    }
+    loops = {}
+    for name, fn in variants.items():
+        fwd, fb = make_loop(fn, (q, k, v, wp, wq), cot, args.iters)
+        loops[f"{name} fwd"] = fwd
+        loops[f"{name} fwd+bwd"] = fb
+    best = {kname: float("inf") for kname in loops}
+    names = list(loops)
+    print(f"shape B={b} L={l} H={h} D={d}")
+    for r in range(args.rounds):
+        for name in names[r % len(names):] + names[: r % len(names)]:
+            t0 = time.perf_counter()
+            loops[name]()
+            best[name] = min(
+                best[name], (time.perf_counter() - t0) / args.iters * 1e3
+            )
+    for name in variants:
+        print(
+            f"  {name:10s} fwd {best[f'{name} fwd']:7.2f} ms   "
+            f"fwd+bwd {best[f'{name} fwd+bwd']:7.2f} ms", flush=True,
+        )
+
+
+if __name__ == "__main__":
+    main()

@@ -31,7 +31,8 @@ def load_digits_48():
     return (up[:split], labels[:split]), (up[split:], labels[split:])
 
 
-def run_variant(logits_dtype, steps, batch_size, eval_every):
+def run_variant(logits_dtype, steps, batch_size, eval_every,
+                compute_dtype="float32"):
     import jax
     import jax.numpy as jnp
 
@@ -47,7 +48,7 @@ def run_variant(logits_dtype, steps, batch_size, eval_every):
         model_name="vit_ti_patch16",
         num_classes=10,
         image_size=48,
-        compute_dtype="float32",
+        compute_dtype=compute_dtype,
         attention_logits_dtype=logits_dtype,
         attention_backend="xla",
         global_batch_size=batch_size,
@@ -59,10 +60,13 @@ def run_variant(logits_dtype, steps, batch_size, eval_every):
         transpose_images=False,
         seed=42,
     )
+    import jax.numpy as jnp
+
     from sav_tpu.models import create_model
 
     model = create_model(
-        cfg.model_name, num_classes=10, patch_shape=(8, 8), backend="xla"
+        cfg.model_name, num_classes=10, patch_shape=(8, 8), backend="xla",
+        dtype=jnp.bfloat16 if compute_dtype == "bfloat16" else jnp.float32,
     )
     tr = Trainer(cfg, model=model)
     state = tr.init_state(0)
@@ -106,6 +110,8 @@ def main():
     p.add_argument("--steps", type=int, default=110)
     p.add_argument("--batch-size", type=int, default=128)
     p.add_argument("--eval-every", type=int, default=22)
+    p.add_argument("--compute-dtype", default="float32",
+                   choices=["float32", "bfloat16"])
     args = p.parse_args()
 
     import jax
@@ -115,9 +121,10 @@ def main():
     results = {}
     for dtype in (None, "bfloat16"):
         key = dtype or "float32"
-        print(f"== {key}", flush=True)
+        print(f"== {key} (compute {args.compute_dtype})", flush=True)
         results[key] = run_variant(dtype, args.steps, args.batch_size,
-                                   args.eval_every)
+                                   args.eval_every,
+                                   compute_dtype=args.compute_dtype)
     f32 = results["float32"][-1][2]
     bf16 = results["bfloat16"][-1][2]
     print(f"\nfinal eval top-1: f32 {f32*100:.1f}%  bf16-logits {bf16*100:.1f}%  "

@@ -5,7 +5,12 @@ from sav_tpu.models.cait import CaiT
 from sav_tpu.models.ceit import CeiT
 from sav_tpu.models.cvt import CvT
 from sav_tpu.models.mlp_mixer import MLPMixer
-from sav_tpu.models.registry import create_model, model_names, register
+from sav_tpu.models.registry import (
+    create_model,
+    model_names,
+    model_supports,
+    register,
+)
 from sav_tpu.models.surgery import adapt_pos_embeds, resize_pos_embed_table
 from sav_tpu.models.tnt import TNT
 from sav_tpu.models.vit import ViT
@@ -22,5 +27,6 @@ __all__ = [
     "MLPMixer",
     "create_model",
     "model_names",
+    "model_supports",
     "register",
 ]

@@ -179,3 +179,14 @@ def create_model(
     if backend is not None and "backend" in cls.__dataclass_fields__:
         merged["backend"] = backend
     return cls(**merged)
+
+
+def model_supports(model_name: str, field: str) -> bool:
+    """Whether the named model's class has ``field`` as a constructor
+    option (e.g. 'remat' — ViT-family only; 'backend' — attention models)."""
+    if model_name not in _REGISTRY:
+        raise ValueError(
+            f"unknown model {model_name!r}; available: {', '.join(model_names())}"
+        )
+    cls, _ = _REGISTRY[model_name]
+    return field in cls.__dataclass_fields__

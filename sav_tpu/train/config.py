@@ -28,6 +28,11 @@ class TrainConfig:
     # (PERF.md §5) at ~2⁻⁸ relative logit precision — accuracy-gate before
     # relying on it for a paper-recipe run.
     attention_logits_dtype: Optional[str] = None
+    # Extra kwargs for create_model (e.g. {'remat': True} to rematerialize
+    # encoder blocks when activations are HBM-bound, or architecture
+    # overrides like {'num_layers': 2} for smoke runs). Serialized with the
+    # config; must be JSON-representable.
+    model_overrides: Optional[dict] = None
 
     # Data
     global_batch_size: int = 1024

@@ -66,6 +66,7 @@ class Trainer:
                 num_classes=config.num_classes,
                 dtype=self.compute_dtype,
                 backend=config.attention_backend,
+                **(config.model_overrides or {}),
             )
         )
         self.schedule = warmup_cosine_schedule(

@@ -242,3 +242,36 @@ def test_cait_pallas_backward_runs_and_matches():
         np.testing.assert_allclose(
             np.asarray(a), np.asarray(b), atol=1e-4, rtol=5e-3
         )
+
+
+def test_vit_remat_matches_no_remat():
+    """remat=True must be numerically identical fwd and bwd (it only changes
+    what the backward rematerializes) while keeping the same param tree."""
+    import numpy as np
+
+    x = jax.random.normal(jax.random.PRNGKey(0), (2, 32, 32, 3))
+    outs, grads = {}, {}
+    for remat in (False, True):
+        model = models.ViT(
+            num_classes=10, embed_dim=32, num_layers=2, num_heads=2,
+            patch_shape=(8, 8), remat=remat,
+        )
+        variables = _randomize_head(
+            model.init({"params": jax.random.PRNGKey(1)}, x, is_training=False)
+        )
+
+        def loss(params):
+            out = model.apply({"params": params}, x, is_training=False)
+            return jnp.sum(out.astype(jnp.float32) ** 2)
+
+        outs[remat] = np.asarray(
+            model.apply(variables, x, is_training=False)
+        )
+        grads[remat] = jax.grad(loss)(variables["params"])
+    np.testing.assert_allclose(outs[True], outs[False], atol=1e-6, rtol=1e-6)
+    flat_t, tree_t = jax.tree.flatten(grads[True])
+    flat_f, tree_f = jax.tree.flatten(grads[False])
+    assert tree_t == tree_f
+    for a, b in zip(flat_t, flat_f):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   atol=1e-5, rtol=1e-5)

@@ -50,6 +50,12 @@ import click
     "[B,H,L,L] HBM traffic; accuracy-gated equal to f32 on the digits "
     "recipe (tools/logits_dtype_gate.py, PERF.md §6).",
 )
+@click.option(
+    "--remat/--no-remat", default=False,
+    help="Rematerialize encoder blocks in the backward pass "
+    "(jax.checkpoint): trades ~1/3 more forward FLOPs for O(layers) "
+    "activation HBM — for batch/sequence sizes that otherwise OOM.",
+)
 @click.option("--dtype", type=click.Choice(["bfloat16", "float32"]), default="bfloat16")
 @click.option("--tp", type=int, default=1, help="Tensor-parallel mesh axis size.")
 @click.option("--fsdp", type=int, default=1, help="FSDP mesh axis size (params sharded).")
@@ -97,7 +103,7 @@ def main(
     ctx, data_dir, fake_data, model_name, num_classes, image_size, batch_size,
     num_epochs, warmup_epochs, learning_rate, weight_decay, label_smoothing,
     clip_grad, grad_accum, augmentation, patch_size, backend, logits_dtype,
-    dtype, tp, fsdp, preset, checkpoint_dir, steps, num_train_images,
+    remat, dtype, tp, fsdp, preset, checkpoint_dir, steps, num_train_images,
     num_eval_images, crop_min_area, train_flip, platform, fused_optimizer, seed,
 ):
     import jax
@@ -143,6 +149,7 @@ def main(
         attention_logits_dtype=(
             None if logits_dtype == "float32" else logits_dtype
         ),
+        model_overrides={"remat": True} if remat else None,
         global_batch_size=batch_size,
         augment=augmentation,
         num_epochs=num_epochs,
@@ -193,6 +200,25 @@ def main(
         if mesh_axes is not None:
             overrides["mesh_axes"] = mesh_axes
         config = get_preset(preset, **overrides)
+        if "remat" in explicit:
+            # Merge into the preset's overrides rather than replacing them —
+            # a preset may carry architecture overrides --remat must not drop.
+            import dataclasses as _dc
+
+            mo = dict(config.model_overrides or {})
+            if remat:
+                mo["remat"] = True
+            else:
+                mo.pop("remat", None)
+            config = _dc.replace(config, model_overrides=mo or None)
+    if (config.model_overrides or {}).get("remat"):
+        from sav_tpu.models import model_supports
+
+        if not model_supports(config.model_name, "remat"):
+            raise click.UsageError(
+                f"--remat is only supported by models with a remat field "
+                f"(ViT/DeiT family); {config.model_name!r} has none"
+            )
     # Refresh locals the data pipeline uses from the final config.
     model_name = config.model_name
     image_size = config.image_size
@@ -215,6 +241,7 @@ def main(
             dtype=jnp.bfloat16 if config.compute_dtype == "bfloat16" else jnp.float32,
             backend=config.attention_backend,
             patch_shape=(patch_size, patch_size),
+            **(config.model_overrides or {}),
         )
     trainer = Trainer(config, model=model)
     # Restore BEFORE building the train stream so the data iterator starts

@@ -197,8 +197,18 @@ def _th_bwd_kernel(q_ref, k_ref, v_ref, g_ref, wpre_ref, wpost_ref,
     The ⟨·,·⟩ head-mix gradients are elementwise VPU reductions (no
     matmul), and every matmul runs storage-dtype-in / f32-accumulate on
     the MXU. dk/dv/dW accumulate in their output blocks across the
-    (sequential, innermost) q-block grid axis."""
+    (sequential, innermost) q-block grid axis.
+
+    Mosaic cannot store rank-0 values to VMEM, so the per-(h, i) scalar
+    mix-weight gradients are scattered into an ``[H, H]`` register tile
+    via iota masks and written with one full-block store per cell."""
     qi = pl.program_id(1)
+    mix_rows = jax.lax.broadcasted_iota(jnp.int32, (heads, heads), 0)
+    mix_cols = jax.lax.broadcasted_iota(jnp.int32, (heads, heads), 1)
+
+    def at_cell(h, i, val):
+        # rank-0 `val` broadcast into the (h, i) slot of an [H, H] tile.
+        return jnp.where((mix_rows == h) & (mix_cols == i), val, 0.0)
 
     @pl.when(qi == 0)
     def _init():
@@ -233,6 +243,7 @@ def _th_bwd_kernel(q_ref, k_ref, v_ref, g_ref, wpre_ref, wpost_ref,
 
     # dP' and dV per output head; dWpost from direct tile reductions.
     dpost = []
+    dwpost_acc = jnp.zeros((heads, heads), jnp.float32)
     for i in range(heads):
         g = g_ref[0, i]
         vi = v_ref[0, i]
@@ -249,10 +260,12 @@ def _th_bwd_kernel(q_ref, k_ref, v_ref, g_ref, wpre_ref, wpost_ref,
             preferred_element_type=jnp.float32,
         )
         for h in range(heads):
-            dwpost_ref[0, h, i] += jnp.sum(probs[h] * dpi)
+            dwpost_acc += at_cell(h, i, jnp.sum(probs[h] * dpi))
+    dwpost_ref[0] += dwpost_acc
 
     # Softmax backward per head, then the pre-mix couplings.
     ds_mixed = []
+    dwpre_acc = jnp.zeros((heads, heads), jnp.float32)
     for i in range(heads):
         dp = dpost[0] * wpost_ref[i, 0]
         for j in range(1, heads):
@@ -261,7 +274,8 @@ def _th_bwd_kernel(q_ref, k_ref, v_ref, g_ref, wpre_ref, wpost_ref,
         ds = pi * (dp - jnp.sum(pi * dp, axis=-1, keepdims=True))
         ds_mixed.append(ds)
         for h in range(heads):
-            dwpre_ref[0, h, i] += jnp.sum(s[h] * ds)
+            dwpre_acc += at_cell(h, i, jnp.sum(s[h] * ds))
+    dwpre_ref[0] += dwpre_acc
 
     for h in range(heads):
         dsh = ds_mixed[0] * wpre_ref[h, 0]

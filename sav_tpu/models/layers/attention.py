@@ -227,9 +227,15 @@ class AttentionBlock(nn.Module):
                     )
                 use_fused = True  # kv-length guard raises inside the kernel
             else:
+                # Measured crossover on v5e (tools/th_micro.py, CaiT-XXS
+                # trunk shape B=256 L=197 H=4 D=48): fused wins fwd+bwd
+                # (5.67 vs 7.13 ms) but loses forward-only (4.40 vs
+                # 3.07 ms) — so 'auto' rides the kernel for training and
+                # dense XLA for inference.
                 use_fused = (
                     backend == "auto"
                     and fused_ok
+                    and is_training
                     and jax.default_backend() == "tpu"
                 )
             if use_fused:

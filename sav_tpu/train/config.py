@@ -23,10 +23,13 @@ class TrainConfig:
     image_size: int = 224
     compute_dtype: str = "bfloat16"
     attention_backend: Optional[str] = None  # None=auto | 'xla' | 'pallas'
-    # Softmax dtype on the XLA attention path. None = float32 (reference
-    # numerics). 'bfloat16' halves the dominant [B,H,L,L] HBM traffic
-    # (PERF.md §5) at ~2⁻⁸ relative logit precision — accuracy-gate before
-    # relying on it for a paper-recipe run.
+    # Softmax dtype on the XLA attention path. None = inherit compute_dtype
+    # (the reference's semantics: its logits einsum runs in the model
+    # dtype). Under bf16 compute this halves the dominant [B,H,L,L] HBM
+    # traffic (−15% step time on v5e, PERF.md §6) at ~2⁻⁸ relative logit
+    # precision; accuracy-gated by tools/logits_dtype_gate.py (identical
+    # final top-1 under f32 and bf16 compute). Set 'float32' to force f32
+    # softmax under bf16 compute.
     attention_logits_dtype: Optional[str] = None
     # Extra kwargs for create_model (e.g. {'remat': True} to rematerialize
     # encoder blocks when activations are HBM-bound, or architecture

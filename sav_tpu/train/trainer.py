@@ -57,7 +57,16 @@ class Trainer:
         # into traces at trace time.
         from sav_tpu.ops.attention import set_default_logits_dtype
 
-        set_default_logits_dtype(config.attention_logits_dtype or "float32")
+        # None inherits the compute dtype — exactly the reference's
+        # semantics (its logits einsum runs in the model dtype,
+        # attention.py:41-48, so a bf16 reference run has bf16 logits).
+        # Accuracy-gated both ways (tools/logits_dtype_gate.py: identical
+        # final top-1 under f32 and bf16 compute) and measured −15% step
+        # time on v5e (PERF.md §6). Force 'float32' for f32 softmax
+        # under bf16 compute.
+        set_default_logits_dtype(
+            config.attention_logits_dtype or config.compute_dtype
+        )
         self.model = (
             model
             if model is not None
@@ -107,7 +116,7 @@ class Trainer:
         the same process may have changed; tracing is lazy, so without this
         a step first traced *after* that change would silently bake in the
         other trainer's dtype. Exposes ``lower`` for the AOT paths."""
-        dtype = self.config.attention_logits_dtype or "float32"
+        dtype = self.config.attention_logits_dtype or self.config.compute_dtype
         from sav_tpu.ops.attention import set_default_logits_dtype
 
         def call(*args, **kwargs):

@@ -95,8 +95,11 @@ def main():
             attention_backend="xla",
             # Trainer resets the process logits-dtype default from this
             # field on construction — set it here, not via the module API.
+            # 'float32' explicitly: None now inherits the compute dtype
+            # (bf16 here), which would collapse base and bf16logits into
+            # the same configuration.
             attention_logits_dtype=(
-                "bfloat16" if variant == "bf16logits" else None
+                "bfloat16" if variant == "bf16logits" else "float32"
             ),
             global_batch_size=args.batch_size,
             transpose_images=False,

@@ -119,8 +119,11 @@ def main():
     jax.config.update("jax_platforms", "cpu")
 
     results = {}
-    for dtype in (None, "bfloat16"):
-        key = dtype or "float32"
+    # Explicit 'float32' for the reference arm: config None now means
+    # *inherit compute dtype*, which under bf16 compute would make both
+    # arms identical and the gate vacuous.
+    for dtype in ("float32", "bfloat16"):
+        key = dtype
         print(f"== {key} (compute {args.compute_dtype})", flush=True)
         results[key] = run_variant(dtype, args.steps, args.batch_size,
                                    args.eval_every,

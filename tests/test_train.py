@@ -341,6 +341,13 @@ def test_fused_optimizer_matches_per_leaf():
     )
 
 
+def _smoke_batch():
+    return {
+        "images": np.zeros((16, 32, 32, 3), np.float32),
+        "labels": np.arange(16) % 10,
+    }
+
+
 def test_logits_dtype_isolated_between_trainers(devices):
     """A trainer's softmax dtype must not leak into another trainer's
     lazily-traced steps: every step call re-asserts its own config's value
@@ -352,10 +359,7 @@ def test_logits_dtype_isolated_between_trainers(devices):
     # Constructing the bf16 trainer set the process default to bf16; the
     # f32 trainer's first (lazy) trace happens after that and must still
     # bake in f32.
-    batch = {
-        "images": np.zeros((16, 32, 32, 3), np.float32),
-        "labels": np.arange(16) % 10,
-    }
+    batch = _smoke_batch()
     state = tr_f32.init_state(0)
     state, _ = tr_f32.train_step(state, batch, jax.random.PRNGKey(0))
     assert att._DEFAULT_LOGITS_DTYPE == jnp.float32
@@ -364,4 +368,35 @@ def test_logits_dtype_isolated_between_trainers(devices):
     assert att._DEFAULT_LOGITS_DTYPE == jnp.bfloat16
     # And back: the f32 trainer's next call restores its own setting.
     tr_f32.eval_step(state, batch)
+    assert att._DEFAULT_LOGITS_DTYPE == jnp.float32
+
+
+def test_logits_dtype_inherits_compute_dtype(devices):
+    """attention_logits_dtype=None resolves to the compute dtype — the
+    reference's semantics (its logits einsum runs in the model dtype), so
+    a bf16-compute trainer softmaxes in bf16 and an f32 one in f32;
+    'float32' still forces f32 softmax under bf16 compute. Trainers are
+    built up front and stepped interleaved so the None-inherited value
+    must survive _pin_logits_dtype re-assertion, not just __init__."""
+    from sav_tpu.ops import attention as att
+
+    batch = _smoke_batch()
+    tr_bf16 = _trainer(_smoke_config(compute_dtype="bfloat16"))
+    tr_f32 = _trainer(_smoke_config())  # compute f32 -> logits f32
+    tr_forced = _trainer(
+        _smoke_config(compute_dtype="bfloat16", attention_logits_dtype="float32")
+    )
+    # tr_forced's construction left the process default at f32; the bf16
+    # trainer's lazy first trace must still re-pin its inherited bf16.
+    tr_bf16.train_step(tr_bf16.init_state(0), batch, jax.random.PRNGKey(0))
+    assert att._DEFAULT_LOGITS_DTYPE == jnp.bfloat16
+
+    tr_f32.train_step(tr_f32.init_state(0), batch, jax.random.PRNGKey(0))
+    assert att._DEFAULT_LOGITS_DTYPE == jnp.float32
+
+    # And interleaved again: bf16 inherit re-pins after an f32 trainer ran.
+    tr_bf16.train_step(tr_bf16.init_state(0), batch, jax.random.PRNGKey(1))
+    assert att._DEFAULT_LOGITS_DTYPE == jnp.bfloat16
+
+    tr_forced.train_step(tr_forced.init_state(0), batch, jax.random.PRNGKey(0))
     assert att._DEFAULT_LOGITS_DTYPE == jnp.float32

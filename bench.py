@@ -67,6 +67,7 @@ def _feed_iterator(feed, batch_size, image_size, tmpdir):
             batch_dims=[batch_size],
             image_size=image_size,
             augment_name="cutmix_mixup_randaugment_405",
+            bfloat16=True,  # late bf16 cast halves host->device bytes
             seed=0,
             process_index=0,
             process_count=1,
@@ -90,7 +91,9 @@ def _feed_iterator(feed, batch_size, image_size, tmpdir):
                 rng.integers(0, 1000, (n,), np.int32),
             )
         ds = SavRecDataset(path)
-        return savrec_train_iterator(ds, batch_size=batch_size, seed=0)
+        return savrec_train_iterator(
+            ds, batch_size=batch_size, seed=0, bfloat16=True
+        )
     raise ValueError(feed)
 
 
@@ -172,8 +175,34 @@ def run(model_name, batch_size, steps, backend, image_size, reps, feed):
 
         # End-to-end: pipeline feeding the real train step.
         it = _feed_iterator(feed, batch_size, image_size, tmpdir)
-        state, metrics = trainer.train_step(state, next(it), rng)
+        first = next(it)
+        state, metrics = trainer.train_step(state, first, rng)
         float(jax.device_get(metrics["loss"]))
+        # Host->device transfer cost for one batch, measured *after* device
+        # compute has run: on some rigs (the relayed bench chip) transfer
+        # bandwidth degrades sharply once a program has executed, and this
+        # is what dominates the fed number there — report it so end-to-end
+        # decomposes into host / transfer / device-step. Best of 3 (the
+        # chip shows transient stalls), synced via device_get of a
+        # reduction over the placed bytes (block_until_ready alone can ack
+        # early on relayed platforms — see the synthetic branch).
+        import jax.numpy as jnp
+
+        transfer_s = float("inf")
+        for _ in range(3):
+            t0 = time.perf_counter()
+            placed = trainer.shard_batch(first)
+            jax.device_get(
+                jax.jit(lambda b: jnp.sum(b.astype(jnp.float32)))(
+                    placed["images"]
+                )
+            )
+            transfer_s = min(transfer_s, time.perf_counter() - t0)
+        nbytes = sum(
+            getattr(v, "nbytes", 0) for v in first.values()
+        )
+        result["transfer_ms_per_batch"] = round(transfer_s * 1e3, 1)
+        result["transfer_mb_per_s"] = round(nbytes / transfer_s / 1e6, 1)
         windows = []
         for _ in range(reps):
             t0 = time.perf_counter()

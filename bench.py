@@ -32,7 +32,8 @@ import time
 
 BASELINE_IMG_PER_SEC_PER_CHIP = 8000.0
 
-def _make_trainer(model_name, batch_size, backend, image_size):
+def _make_trainer(model_name, batch_size, backend, image_size,
+                  device_preprocess=False, augment=None):
     from sav_tpu.train import TrainConfig, Trainer
 
     config = TrainConfig(
@@ -44,12 +45,14 @@ def _make_trainer(model_name, batch_size, backend, image_size):
         global_batch_size=batch_size,
         transpose_images=False,
         clip_grad_norm=1.0,
+        device_preprocess=device_preprocess,
         seed=0,
+        **({"augment": augment} if augment is not None else {}),
     )
     return Trainer(config)
 
 
-def _feed_iterator(feed, batch_size, image_size, tmpdir):
+def _feed_iterator(feed, batch_size, image_size, tmpdir, device_preprocess=False):
     """Host-side batch stream for the fed modes."""
     import numpy as np
 
@@ -67,7 +70,10 @@ def _feed_iterator(feed, batch_size, image_size, tmpdir):
             batch_dims=[batch_size],
             image_size=image_size,
             augment_name="cutmix_mixup_randaugment_405",
-            bfloat16=True,  # late bf16 cast halves host->device bytes
+            # uint8 (device_preprocess) quarters host->device bytes vs
+            # f32; otherwise late bf16 halves them.
+            bfloat16=True,
+            device_preprocess=device_preprocess,
             seed=0,
             process_index=0,
             process_count=1,
@@ -92,17 +98,27 @@ def _feed_iterator(feed, batch_size, image_size, tmpdir):
             )
         ds = SavRecDataset(path)
         return savrec_train_iterator(
-            ds, batch_size=batch_size, seed=0, bfloat16=True
+            ds, batch_size=batch_size, seed=0,
+            normalize=not device_preprocess,
+            bfloat16=not device_preprocess,
         )
     raise ValueError(feed)
 
 
-def run(model_name, batch_size, steps, backend, image_size, reps, feed):
+def run(model_name, batch_size, steps, backend, image_size, reps, feed,
+        device_preprocess=False):
     import jax
 
     from sav_tpu.data import synthetic_data_iterator
 
-    trainer = _make_trainer(model_name, batch_size, backend, image_size)
+    # The savrec path never mixes on the host, so its device_preprocess
+    # trainer must not mix either — otherwise the A/B conflates "moved
+    # normalize to device" with "added CutMix/MixUp the baseline lacks".
+    # The tf.data feed mixes on both sides (host mixes vs device mixes).
+    trainer = _make_trainer(
+        model_name, batch_size, backend, image_size, device_preprocess,
+        augment="none" if feed == "savrec" else None,
+    )
     state = trainer.init_state()
     rng = jax.random.PRNGKey(0)
     result: dict = {}
@@ -163,7 +179,7 @@ def run(model_name, batch_size, steps, backend, image_size, reps, feed):
 
         tmpdir = tempfile.mkdtemp(prefix="sav_bench_")
         # Host-only pipeline rate (how fast the input side alone can go).
-        it = _feed_iterator(feed, batch_size, image_size, tmpdir)
+        it = _feed_iterator(feed, batch_size, image_size, tmpdir, device_preprocess)
         for _ in range(2):
             next(it)  # warm caches / tf.data autotune
         t0 = time.perf_counter()
@@ -174,7 +190,7 @@ def run(model_name, batch_size, steps, backend, image_size, reps, feed):
         result["host_pipeline_img_per_sec"] = round(host_rate, 1)
 
         # End-to-end: pipeline feeding the real train step.
-        it = _feed_iterator(feed, batch_size, image_size, tmpdir)
+        it = _feed_iterator(feed, batch_size, image_size, tmpdir, device_preprocess)
         first = next(it)
         state, metrics = trainer.train_step(state, first, rng)
         float(jax.device_get(metrics["loss"]))
@@ -247,15 +263,31 @@ def main(argv=None):
         "--reps", type=int, default=4,
         help="timed windows; best and median are both reported",
     )
+    parser.add_argument(
+        "--device-preprocess", action="store_true",
+        help="fed modes ship post-augment uint8 (4x fewer bytes than f32) "
+        "and the jitted step normalizes + mixes on device "
+        "(TrainConfig.device_preprocess)",
+    )
     args = parser.parse_args(argv)
+    if args.device_preprocess and args.feed == "synthetic":
+        parser.error(
+            "--device-preprocess measures the fed paths (uint8 transfer + "
+            "on-device finishing); the synthetic feed ships device-resident "
+            "f32 batches, so the combination would mislabel the metric"
+        )
 
     value, n_chips, extra = run(
         args.model, args.batch_size, args.steps, args.backend,
         args.image_size, reps=args.reps, feed=args.feed,
+        device_preprocess=args.device_preprocess,
+    )
+    feed_desc = args.feed + (
+        " uint8+device-preprocess" if args.device_preprocess else ""
     )
     out = {
         "metric": f"{args.model} train img/s/chip (bs={args.batch_size}, "
-        f"bf16, {args.backend} attention, {args.feed} feed, {n_chips} chip, "
+        f"bf16, {args.backend} attention, {feed_desc} feed, {n_chips} chip, "
         f"best of {args.reps}x{args.steps}-step windows)",
         "value": round(value, 1),
         "unit": "img/s/chip",

@@ -1,0 +1,10 @@
+"""Normalization constants shared by the host (TF/C++) and device (JAX)
+preprocessing paths. Values are the ImageNet channel statistics on the
+0..255 scale (reference input_pipeline.py MEAN_RGB/STDDEV_RGB).
+
+TF-free on purpose: the device path (sav_tpu.ops.preprocess) must be
+importable without TensorFlow.
+"""
+
+MEAN_RGB = (0.485 * 255, 0.456 * 255, 0.406 * 255)
+STDDEV_RGB = (0.229 * 255, 0.224 * 255, 0.225 * 255)

@@ -41,8 +41,10 @@ try:
 except ImportError:  # pragma: no cover
     _BF16 = None
 
-MEAN_RGB = (0.485 * 255, 0.456 * 255, 0.406 * 255)
-STDDEV_RGB = (0.229 * 255, 0.224 * 255, 0.225 * 255)
+# Canonical values live in the TF-free constants module (the device
+# preprocessing path imports them without TF); re-exported here for the
+# existing import surface.
+from sav_tpu.data.constants import MEAN_RGB, STDDEV_RGB  # noqa: E402
 
 
 class Split(enum.Enum):
@@ -290,8 +292,16 @@ def load(
     split_examples: Optional[int] = None,
     crop_area_range: tuple = (0.08, 1.0),
     random_flip: bool = True,
+    device_preprocess: bool = False,
 ) -> Generator[dict, None, None]:
     """Build the input generator. See module docstring.
+
+    ``device_preprocess``: stop host work after the augment stage and emit
+    **uint8** images — normalize and CutMix/MixUp then run inside the
+    jitted train step (``TrainConfig.device_preprocess``,
+    sav_tpu/ops/preprocess.py). 4x fewer host->device bytes than f32 and
+    the host sheds its normalize/mix arithmetic. Mixed-image requantization
+    makes it incompatible with ``augment_before_mix=False``.
 
     ``batch_dims``: leading batch shape, outermost first (reference
     semantics: ``[local_devices, per_device_bs]``; pjit callers typically
@@ -314,7 +324,9 @@ def load(
     total_batch = int(np.prod(batch_dims))
 
     if fake_data:
-        yield from _fake_batches(batch_dims, image_size, transpose, bfloat16)
+        yield from _fake_batches(
+            batch_dims, image_size, transpose, bfloat16, device_preprocess
+        )
         return
     if tf is None:
         raise ImportError("tensorflow required for the real input pipeline")
@@ -404,6 +416,12 @@ def load(
         and spec.mixes
         and (spec.randaugment is not None or spec.autoaugment)
     )
+    if device_preprocess and aug_after_mix:
+        raise ValueError(
+            "device_preprocess moves CutMix/MixUp into the jitted step, so "
+            "the host cannot re-augment mixed images; use "
+            "augment_before_mix=True (default) with device_preprocess"
+        )
 
     def preprocess(example):
         if is_training:
@@ -427,7 +445,7 @@ def load(
     drop_remainder = is_training or len(batch_dims) > 1
     ds = ds.batch(total_batch, drop_remainder=drop_remainder)
 
-    if is_training and spec is not None and spec.mixes:
+    if is_training and spec is not None and spec.mixes and not device_preprocess:
         from sav_tpu.data.mix import apply_mixes
 
         # Mixes run on 0..255 floats before normalization (commutes with the
@@ -450,7 +468,19 @@ def load(
 
     def finalize(batch):
         batch = dict(batch)
-        batch["images"] = _normalize(batch["images"])
+        if device_preprocess:
+            # Ship uint8; the jitted step normalizes (+ mixes when
+            # training). Post-augment images may already be uint8 (RA/AA
+            # output); float crop output is requantized round-to-nearest,
+            # bounding the deviation at 0.5/255 — the same quantization
+            # the augment stage applies whenever RA/AA runs.
+            if batch["images"].dtype != tf.uint8:
+                batch["images"] = tf.cast(
+                    tf.clip_by_value(tf.round(batch["images"]), 0.0, 255.0),
+                    tf.uint8,
+                )
+        else:
+            batch["images"] = _normalize(batch["images"])
         images = batch["images"]
         lead = list(batch_dims)
         if len(lead) > 1:
@@ -477,7 +507,7 @@ def load(
     ds = ds.map(finalize, num_parallel_calls=tf.data.AUTOTUNE)
     ds = ds.prefetch(tf.data.AUTOTUNE)
 
-    if bfloat16 and _BF16 is not None:
+    if bfloat16 and _BF16 is not None and not device_preprocess:
         # Late cast on the host halves host→device bytes (the reference's
         # bf16 view fix-up, input_pipeline.py:238-243); the native loader
         # core does it threaded with the GIL released when built.
@@ -571,7 +601,8 @@ def resumable_train_iterator(
         skip = 0
 
 
-def _fake_batches(batch_dims, image_size, transpose, bfloat16):
+def _fake_batches(batch_dims, image_size, transpose, bfloat16,
+                  device_preprocess=False):
     lead = list(batch_dims)
     img = [image_size, image_size, 3]
     if transpose:
@@ -579,7 +610,10 @@ def _fake_batches(batch_dims, image_size, transpose, bfloat16):
         shape = img + [lead[0]] if len(lead) == 1 else lead[:-1] + img + [lead[-1]]
     else:
         shape = lead + img
-    dtype = _BF16 if (bfloat16 and _BF16 is not None) else np.float32
+    if device_preprocess:  # real path ships uint8 in this mode
+        dtype = np.uint8
+    else:
+        dtype = _BF16 if (bfloat16 and _BF16 is not None) else np.float32
     images = np.zeros(shape, dtype)
     labels = np.zeros(lead, np.int32)
     while True:

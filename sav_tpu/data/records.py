@@ -250,6 +250,15 @@ def savrec_train_iterator(
     TF dependency. Wrap in :class:`~sav_tpu.data.native_loader.PrefetchLoader`
     to overlap with device compute.
     """
+    if transpose and not normalize:
+        # The HWCN transpose is fused into the C++ normalize; the raw
+        # (device-preprocess) path has no host transpose, and yielding
+        # NHWC while the trainer expects HWCN would shard/permute wrongly.
+        raise ValueError(
+            "transpose=True requires normalize=True (the transpose is fused "
+            "into the C++ normalize); the raw uint8 path ships NHWC — use "
+            "transpose_images=False with device_preprocess"
+        )
     if mean is None or stddev is None:
         from sav_tpu.data.pipeline import MEAN_RGB, STDDEV_RGB
 

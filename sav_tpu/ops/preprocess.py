@@ -1,0 +1,146 @@
+"""Device-side batch preprocessing: normalize + CutMix/MixUp under jit.
+
+TPU-first alternative to finishing batches on the host
+(sav_tpu/data/mix.py + the pipeline's normalize stage): the host ships
+post-augment **uint8** images — 4x fewer host->device bytes than f32,
+2x fewer than late-bf16 — and the jitted train step normalizes and mixes
+on device, where both are bandwidth-trivial fused elementwise work. The
+host also sheds its normalize/mix arithmetic (it is the scarce resource
+on TPU machines; SURVEY.md §7).
+
+Semantics mirror the host path op-for-op so the two are interchangeable
+(tests assert it): mixes act on 0..255 values *before* normalization
+(convex combinations and box-masks commute with the per-channel affine
+normalize — sav_tpu/data/mix.py docstring), MixUp draws one
+Beta(alpha, alpha) ratio per example against the roll-by-1 partner
+(reference input_pipeline.py:169-178 attaches per-example ratios),
+CutMix boxes are per-example with kept-area label ratios
+(:166-168, 248-282), and the combined policy runs MixUp on the first
+half / CutMix on the second (``my_mixup_cutmix``, :328-350). The only
+deliberate difference is the RNG stream: ``jax.random`` from the step
+seed instead of TF's — distributions are identical, so training
+statistics match while batches become replayable from (seed, step).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from sav_tpu.data.constants import MEAN_RGB, STDDEV_RGB
+
+
+def normalize_images(images: jax.Array, dtype=jnp.float32) -> jax.Array:
+    """(x - MEAN_RGB) / STDDEV_RGB on 0..255 input, cast to ``dtype``.
+
+    Matches the host `_normalize` (pipeline.py) exactly; accepts uint8 or
+    float input. Statistics are applied in f32 before the storage cast so
+    uint8 and pre-floated inputs produce identical values.
+    """
+    x = images.astype(jnp.float32)
+    mean = jnp.asarray(MEAN_RGB, jnp.float32).reshape(1, 1, 1, 3)
+    std = jnp.asarray(STDDEV_RGB, jnp.float32).reshape(1, 1, 1, 3)
+    return ((x - mean) / std).astype(dtype)
+
+
+def mixup(
+    rng: jax.Array, images: jax.Array, labels: jax.Array, alpha: float = 0.2
+) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """images <- r*x + (1-r)*roll(x), r ~ Beta(alpha, alpha) per example.
+
+    Returns (mixed_images, mix_labels, ratio); images are 0..255 floats.
+    """
+    n = images.shape[0]
+    x = images.astype(jnp.float32)
+    ratio = jax.random.beta(rng, alpha, alpha, (n,))
+    r = ratio[:, None, None, None]
+    mixed = r * x + (1.0 - r) * jnp.roll(x, 1, axis=0)
+    return mixed, jnp.roll(labels, 1, axis=0), ratio
+
+
+def _cutmix_mask(
+    rng: jax.Array, n: int, height: int, width: int
+) -> Tuple[jax.Array, jax.Array]:
+    """Per-example keep-mask ``[n, h, w, 1]`` + kept-area ratio ``[n]``.
+
+    Box side fraction sqrt(1 - lam), lam ~ Beta(1,1) = U(0,1) — the
+    reference's ``cutmix_padding`` distribution; mirrors
+    sav_tpu/data/mix.py:_cutmix_mask including its center/clip geometry.
+    """
+    k_lam, k_cy, k_cx = jax.random.split(rng, 3)
+    lam = jax.random.uniform(k_lam, (n,))
+    cut = jnp.sqrt(1.0 - lam)
+    cut_h = (cut * height).astype(jnp.int32)
+    cut_w = (cut * width).astype(jnp.int32)
+    cy = jax.random.randint(k_cy, (n,), 0, height)
+    cx = jax.random.randint(k_cx, (n,), 0, width)
+    y0 = jnp.clip(cy - cut_h // 2, 0, height)[:, None, None, None]
+    y1 = jnp.clip(cy + cut_h // 2, 0, height)[:, None, None, None]
+    x0 = jnp.clip(cx - cut_w // 2, 0, width)[:, None, None, None]
+    x1 = jnp.clip(cx + cut_w // 2, 0, width)[:, None, None, None]
+    rows = jax.lax.broadcasted_iota(jnp.int32, (1, height, 1, 1), 1)
+    cols = jax.lax.broadcasted_iota(jnp.int32, (1, 1, width, 1), 2)
+    inside = (rows >= y0) & (rows < y1) & (cols >= x0) & (cols < x1)
+    keep = 1.0 - inside.astype(jnp.float32)
+    ratio = jnp.mean(keep, axis=(1, 2, 3))
+    return keep, ratio
+
+
+def cutmix(
+    rng: jax.Array, images: jax.Array, labels: jax.Array, alpha: float = 1.0
+) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """Paste a random box from the rolled partner; label ratio = kept area."""
+    del alpha  # Beta(1, 1) like the reference's cutmix_padding
+    n, h, w = images.shape[0], images.shape[1], images.shape[2]
+    x = images.astype(jnp.float32)
+    keep, ratio = _cutmix_mask(rng, n, h, w)
+    mixed = keep * x + (1.0 - keep) * jnp.roll(x, 1, axis=0)
+    return mixed, jnp.roll(labels, 1, axis=0), ratio
+
+
+def mixup_and_cutmix(
+    rng: jax.Array,
+    images: jax.Array,
+    labels: jax.Array,
+    *,
+    mixup_alpha: float = 0.2,
+    cutmix_alpha: float = 1.0,
+) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """MixUp on the first half of the batch, CutMix on the second
+    (roll-partners inside each half), like the host combined policy."""
+    k_mu, k_cm = jax.random.split(rng)
+    half = images.shape[0] // 2
+    mu_x, mu_l, mu_r = mixup(k_mu, images[:half], labels[:half], mixup_alpha)
+    cm_x, cm_l, cm_r = cutmix(k_cm, images[half:], labels[half:], cutmix_alpha)
+    return (
+        jnp.concatenate([mu_x, cm_x], axis=0),
+        jnp.concatenate([mu_l, cm_l], axis=0),
+        jnp.concatenate([mu_r, cm_r], axis=0),
+    )
+
+
+def apply_mixes(
+    rng: jax.Array, images: jax.Array, labels: jax.Array, spec
+) -> Tuple[jax.Array, Optional[jax.Array], Optional[jax.Array]]:
+    """Apply the mixes an :class:`AugmentSpec` selects (device analogue of
+    sav_tpu/data/mix.py:apply_mixes). Returns
+    ``(images_0_255, mix_labels | None, ratio | None)``.
+    """
+    if spec is None:
+        return images.astype(jnp.float32), None, None
+    if spec.cutmix and spec.mixup:
+        x, ml, r = mixup_and_cutmix(
+            rng,
+            images,
+            labels,
+            mixup_alpha=spec.mixup_alpha,
+            cutmix_alpha=spec.cutmix_alpha,
+        )
+        return x, ml, r
+    if spec.mixup:
+        return mixup(rng, images, labels, spec.mixup_alpha)
+    if spec.cutmix:
+        return cutmix(rng, images, labels, spec.cutmix_alpha)
+    return images.astype(jnp.float32), None, None

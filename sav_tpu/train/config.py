@@ -37,6 +37,16 @@ class TrainConfig:
     # config; must be JSON-representable.
     model_overrides: Optional[dict] = None
 
+    # Device-side batch finishing: the host pipeline ships post-augment
+    # uint8 images (4x fewer host->device bytes than f32, 2x fewer than
+    # late-bf16) and the jitted steps normalize + apply the augment
+    # string's CutMix/MixUp on device with replayable jax.random draws
+    # (sav_tpu/ops/preprocess.py). Pair with
+    # load(device_preprocess=True) or savrec_train_iterator(normalize=False);
+    # the savrec raw path ships NHWC only, so keep transpose_images=False
+    # with it (the iterator rejects the combination).
+    device_preprocess: bool = False
+
     # Data
     global_batch_size: int = 1024
     num_train_images: int = 1_281_167  # ImageNet-1k train

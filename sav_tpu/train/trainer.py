@@ -51,6 +51,16 @@ class Trainer:
         self.compute_dtype = (
             jnp.bfloat16 if config.compute_dtype == "bfloat16" else jnp.float32
         )
+        if config.device_preprocess:
+            # Host ships post-augment uint8; normalize + the augment
+            # string's mixes run inside the jitted steps
+            # (sav_tpu/ops/preprocess.py). Parsed once — the spec is
+            # static, baked into the trace.
+            from sav_tpu.data.augment_spec import parse_augment_spec
+
+            self._mix_spec = parse_augment_spec(config.augment)
+        else:
+            self._mix_spec = None
         # Set unconditionally (None = f32) so one Trainer's bf16 setting
         # can't leak into the next Trainer built in the same process. Must
         # happen before any jit tracing of the model — the default is baked
@@ -213,9 +223,37 @@ class Trainer:
             onehot = optax.smooth_labels(onehot, self.config.label_smoothing)
         return onehot
 
+    def _device_preprocess(self, batch: dict, rng, training: bool) -> dict:
+        """uint8 host batch → mixed (train) + normalized compute-dtype
+        images, on device (TrainConfig.device_preprocess; see
+        sav_tpu/ops/preprocess.py for the host-parity contract)."""
+        from sav_tpu.ops import preprocess as pp
+
+        images = batch["images"]
+        if self.config.transpose_images and images.ndim == 4:
+            images = jnp.transpose(images, (3, 0, 1, 2))  # HWCN → NHWC
+        batch = dict(batch)
+        if training and self._mix_spec is not None and self._mix_spec.mixes:
+            images, mix_labels, ratio = pp.apply_mixes(
+                rng, images, batch["labels"], self._mix_spec
+            )
+            if mix_labels is not None:
+                batch["mix_labels"] = mix_labels
+                batch["ratio"] = ratio
+        batch["images"] = pp.normalize_images(images, self.compute_dtype)
+        return batch
+
     def _train_step_impl(self, state: TrainState, batch: dict, rng: jax.Array):
         step_rng = jax.random.fold_in(rng, state.step)
-        images = self._prep_images(batch["images"])
+        if self.config.device_preprocess:
+            # Dedicated fold so the mix draws are independent of the
+            # dropout/stochastic-depth streams split from step_rng below.
+            batch = self._device_preprocess(
+                batch, jax.random.fold_in(step_rng, 0x6D69), training=True
+            )
+            images = batch["images"]  # already NHWC, compute dtype
+        else:
+            images = self._prep_images(batch["images"])
         label_probs = self._label_probs(batch)
         has_bn = bool(state.batch_stats)
 
@@ -339,7 +377,11 @@ class Trainer:
         return self._train_many(state, placed, rng)
 
     def _eval_step_impl(self, state: TrainState, batch: dict):
-        images = self._prep_images(batch["images"])
+        if self.config.device_preprocess:
+            batch = self._device_preprocess(batch, None, training=False)
+            images = batch["images"]
+        else:
+            images = self._prep_images(batch["images"])
         variables = {"params": state.params}
         if state.batch_stats:
             variables["batch_stats"] = state.batch_stats

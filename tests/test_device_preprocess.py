@@ -1,0 +1,240 @@
+"""Device-side preprocessing (sav_tpu.ops.preprocess +
+TrainConfig.device_preprocess): host ships post-augment uint8, the jitted
+steps normalize and mix on device. Tests pin the host-parity contract the
+module docstring promises."""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+from sav_tpu.ops import preprocess as pp
+
+
+def _uint8_images(n=8, size=32, seed=0):
+    rng = np.random.default_rng(seed)
+    images = rng.integers(0, 256, (n, size, size, 3), dtype=np.uint8)
+    labels = rng.integers(0, 10, (n,), dtype=np.int32)
+    return images, labels
+
+
+# ------------------------------------------------------------- normalize
+
+
+def test_normalize_matches_host_normalize():
+    tf = pytest.importorskip("tensorflow")
+    from sav_tpu.data.pipeline import _normalize
+
+    images, _ = _uint8_images()
+    host = _normalize(tf.cast(tf.constant(images), tf.float32)).numpy()
+    dev = np.asarray(pp.normalize_images(jnp.asarray(images), jnp.float32))
+    np.testing.assert_allclose(dev, host, atol=1e-5)
+
+
+def test_normalize_uint8_and_float_inputs_identical():
+    images, _ = _uint8_images()
+    a = pp.normalize_images(jnp.asarray(images), jnp.float32)
+    b = pp.normalize_images(jnp.asarray(images, jnp.float32), jnp.float32)
+    np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+# ------------------------------------------------------------------ mixes
+
+
+def test_mixup_is_convex_roll_combination():
+    images, labels = _uint8_images()
+    x = jnp.asarray(images)
+    mixed, mix_labels, ratio = pp.mixup(jax.random.PRNGKey(0), x, jnp.asarray(labels))
+    r = np.asarray(ratio)
+    assert ((0.0 <= r) & (r <= 1.0)).all()
+    expect = (
+        r[:, None, None, None] * images.astype(np.float32)
+        + (1.0 - r[:, None, None, None]) * np.roll(images, 1, 0).astype(np.float32)
+    )
+    np.testing.assert_allclose(np.asarray(mixed), expect, atol=1e-3)
+    np.testing.assert_array_equal(np.asarray(mix_labels), np.roll(labels, 1, 0))
+
+
+def test_cutmix_box_and_ratio_consistent():
+    images, labels = _uint8_images(n=16, size=64)
+    x = jnp.asarray(images)
+    mixed, mix_labels, ratio = pp.cutmix(jax.random.PRNGKey(3), x, jnp.asarray(labels))
+    mixed = np.asarray(mixed)
+    rolled = np.roll(images, 1, 0).astype(np.float32)
+    own = images.astype(np.float32)
+    for i in range(len(images)):
+        from_own = np.isclose(mixed[i], own[i]).all(-1)
+        from_partner = np.isclose(mixed[i], rolled[i]).all(-1)
+        # Every pixel comes from exactly one source (ignoring the rare
+        # pixel where both sources agree), and the kept-area fraction is
+        # the label ratio.
+        assert (from_own | from_partner).all()
+        assert abs(from_own.mean() - float(ratio[i])) < 0.02
+
+
+def test_combined_policy_splits_halves():
+    images, labels = _uint8_images(n=8, size=32)
+    x = jnp.asarray(images)
+    mixed, mix_labels, ratio = pp.mixup_and_cutmix(
+        jax.random.PRNGKey(1), x, jnp.asarray(labels)
+    )
+    assert mixed.shape == x.shape
+    # Halves roll within themselves, like the host combined policy.
+    np.testing.assert_array_equal(np.asarray(mix_labels[:4]), np.roll(labels[:4], 1, 0))
+    np.testing.assert_array_equal(np.asarray(mix_labels[4:]), np.roll(labels[4:], 1, 0))
+
+
+def test_apply_mixes_none_spec_passthrough():
+    images, labels = _uint8_images()
+    out, ml, r = pp.apply_mixes(
+        jax.random.PRNGKey(0), jnp.asarray(images), jnp.asarray(labels), None
+    )
+    assert ml is None and r is None
+    np.testing.assert_array_equal(np.asarray(out), images.astype(np.float32))
+
+
+# ------------------------------------------------------- pipeline contract
+
+
+def test_load_device_preprocess_emits_uint8_without_mix_keys():
+    tf = pytest.importorskip("tensorflow")
+    from sav_tpu.data import Split, load
+
+    images, labels = _uint8_images(n=32, size=48)
+    it = load(
+        Split.TRAIN,
+        source=(images, labels),
+        is_training=True,
+        batch_dims=[8],
+        image_size=32,
+        augment_name="cutmix_mixup",
+        device_preprocess=True,
+        seed=0,
+        process_index=0,
+        process_count=1,
+    )
+    batch = next(it)
+    assert batch["images"].dtype == np.uint8
+    assert "mix_labels" not in batch and "ratio" not in batch
+
+
+def test_load_device_preprocess_rejects_augment_after_mix():
+    tf = pytest.importorskip("tensorflow")
+    from sav_tpu.data import Split, load
+
+    images, labels = _uint8_images(n=32, size=48)
+    with pytest.raises(ValueError, match="device_preprocess"):
+        next(
+            load(
+                Split.TRAIN,
+                source=(images, labels),
+                is_training=True,
+                batch_dims=[8],
+                image_size=32,
+                augment_name="cutmix_mixup_randaugment_405",
+                augment_before_mix=False,
+                device_preprocess=True,
+                seed=0,
+                process_index=0,
+                process_count=1,
+            )
+        )
+
+
+# ----------------------------------------------------------- trainer path
+
+
+def test_trainer_device_preprocess_end_to_end(devices):
+    from sav_tpu.train import TrainConfig, Trainer
+    from sav_tpu.models import create_model
+
+    config = TrainConfig(
+        model_name="vit_ti_patch16",
+        num_classes=10,
+        image_size=32,
+        compute_dtype="float32",
+        global_batch_size=16,
+        num_train_images=64,
+        num_epochs=2,
+        warmup_epochs=1,
+        transpose_images=False,
+        augment="cutmix_mixup",
+        device_preprocess=True,
+        seed=0,
+    )
+    model = create_model(
+        "vit_ti_patch16", num_classes=10, num_layers=2, embed_dim=64,
+        num_heads=4, dtype=jnp.float32,
+    )
+    trainer = Trainer(config, model=model)
+    images, labels = _uint8_images(n=16, size=32)
+    batch = {"images": images, "labels": labels}
+    state = trainer.init_state(0)
+    state, metrics = trainer.train_step(state, batch, jax.random.PRNGKey(0))
+    assert np.isfinite(float(metrics["loss"]))
+    eval_metrics = trainer.eval_step(state, batch)
+    assert np.isfinite(float(jax.device_get(eval_metrics["loss_sum"])))
+
+
+def test_trainer_device_preprocess_replayable(devices):
+    """Same (state.step, rng) → identical mix draws → identical loss."""
+    from sav_tpu.train import TrainConfig, Trainer
+    from sav_tpu.models import create_model
+
+    config = TrainConfig(
+        model_name="vit_ti_patch16",
+        num_classes=10,
+        image_size=32,
+        compute_dtype="float32",
+        global_batch_size=16,
+        num_train_images=64,
+        num_epochs=2,
+        warmup_epochs=1,
+        transpose_images=False,
+        augment="mixup",
+        device_preprocess=True,
+        seed=0,
+    )
+    model = create_model(
+        "vit_ti_patch16", num_classes=10, num_layers=2, embed_dim=64,
+        num_heads=4, dtype=jnp.float32,
+    )
+    trainer = Trainer(config, model=model)
+    images, labels = _uint8_images(n=16, size=32)
+    batch = {"images": images, "labels": labels}
+    l1 = float(
+        trainer.train_step(trainer.init_state(0), batch, jax.random.PRNGKey(7))[1][
+            "loss"
+        ]
+    )
+    l2 = float(
+        trainer.train_step(trainer.init_state(0), batch, jax.random.PRNGKey(7))[1][
+            "loss"
+        ]
+    )
+    assert l1 == l2
+
+
+def test_savrec_raw_path_rejects_transpose(tmp_path):
+    """The HWCN transpose is fused into the C++ normalize; the raw uint8
+    (device-preprocess) path must reject transpose rather than silently
+    yield NHWC to a trainer expecting HWCN."""
+    from sav_tpu.data.records import (
+        SavRecDataset,
+        savrec_train_iterator,
+        write_savrec,
+    )
+
+    images, labels = _uint8_images(n=8, size=16)
+    path = str(tmp_path / "t.savrec")
+    write_savrec(path, images, labels.astype(np.int32))
+    with pytest.raises(ValueError, match="transpose"):
+        next(
+            savrec_train_iterator(
+                SavRecDataset(path),
+                batch_size=4,
+                seed=0,
+                normalize=False,
+                transpose=True,
+            )
+        )

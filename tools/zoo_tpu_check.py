@@ -1,0 +1,120 @@
+#!/usr/bin/env python
+"""Compile-and-run every registry model family on the real TPU, fwd+bwd.
+
+Interpret-mode CPU tests exercise kernel *numerics*, but only the real
+Mosaic/XLA-TPU compilers prove the programs build on hardware (a rank-0
+VMEM store passed every CPU test and failed on-chip — see PERF.md §6).
+This sweep drives one small config per family through ``create_model``
+fwd+bwd per available backend and reports compile/run/nonfinite status.
+
+Run: python tools/zoo_tpu_check.py  (~a few minutes; needs the TPU)
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+import time
+import traceback
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+# One representative per family, smallest config, reduced layers where
+# the registry allows overrides. Image sizes keep token counts real
+# (224² ViT grid) but trim the giant models.
+CASES = [
+    # (name, kwargs, image_size, backends)
+    ("vit_ti_patch16", {}, 224, ("xla", "pallas")),
+    ("deit_s_patch16", {}, 224, ("xla", "pallas")),
+    ("vit_s_patch16_rope", {}, 224, ("xla", "pallas")),
+    ("vit_moe_s_patch16_e8", {}, 224, ("xla",)),
+    ("cait_xxs_24", {}, 224, ("xla", "pallas")),  # talking-heads trunk
+    ("cvt-13", {}, 224, ("xla", "pallas")),
+    ("ceit_t", {}, 224, ("xla", "pallas")),
+    ("tnt_s_patch16", {}, 224, ("xla", "pallas")),
+    ("botnet_t3", {}, 224, ("xla", "pallas")),  # fused rel-pos kernel
+    ("mixer_s_patch16", {}, 224, ("xla",)),  # no attention
+]
+
+
+def check(name: str, kwargs: dict, image_size: int, backend: str, batch: int):
+    import jax
+    import jax.numpy as jnp
+
+    from sav_tpu.models import create_model
+
+    x = jax.random.normal(
+        jax.random.PRNGKey(0), (batch, image_size, image_size, 3), jnp.bfloat16
+    )
+    y = jax.random.randint(jax.random.PRNGKey(1), (batch,), 0, 10)
+    model = create_model(
+        name, num_classes=10, dtype=jnp.bfloat16, backend=backend, **kwargs
+    )
+    rngs = {"params": jax.random.PRNGKey(0), "dropout": jax.random.PRNGKey(1)}
+    variables = model.init(rngs, x, is_training=False)
+    params = variables.pop("params")
+    # Zero-init heads make fresh logits vacuous; randomize before grads.
+    if "head" in params and "kernel" in params["head"]:
+        params["head"]["kernel"] = 0.02 * jax.random.normal(
+            jax.random.PRNGKey(2), params["head"]["kernel"].shape, jnp.float32
+        )
+
+    def loss_fn(p):
+        out = model.apply(
+            {"params": p, **variables},
+            x,
+            is_training=True,
+            rngs={
+                "dropout": jax.random.PRNGKey(3),
+                "stochastic_depth": jax.random.PRNGKey(4),
+            },
+            **({"mutable": list(variables)} if variables else {}),
+        )
+        logits = out[0] if variables else out
+        onehot = jax.nn.one_hot(y, 10)
+        return -jnp.mean(
+            jnp.sum(jax.nn.log_softmax(logits.astype(jnp.float32)) * onehot, -1)
+        )
+
+    t0 = time.perf_counter()
+    loss, grads = jax.jit(jax.value_and_grad(loss_fn))(params)
+    loss = float(jax.device_get(loss))
+    finite = all(
+        bool(jax.numpy.all(jax.numpy.isfinite(g.astype(jax.numpy.float32))))
+        for g in jax.tree_util.tree_leaves(grads)
+    )
+    dt = time.perf_counter() - t0
+    return loss, finite, dt
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--batch", type=int, default=4)
+    p.add_argument("--only", default=None, help="substring filter on model name")
+    args = p.parse_args()
+
+    failures = 0
+    for name, kwargs, image_size, backends in CASES:
+        if args.only and args.only not in name:
+            continue
+        for backend in backends:
+            try:
+                loss, finite, dt = check(name, kwargs, image_size, backend, args.batch)
+                status = "OK " if finite else "NONFINITE"
+                print(
+                    f"{status} {name:24s} {backend:6s} loss={loss:.4f} "
+                    f"compile+run {dt:.1f}s",
+                    flush=True,
+                )
+                failures += 0 if finite else 1
+            except Exception:
+                failures += 1
+                print(f"FAIL {name:24s} {backend:6s}", flush=True)
+                traceback.print_exc()
+    print(f"\n{'ALL OK' if failures == 0 else f'{failures} FAILURES'}")
+    raise SystemExit(1 if failures else 0)
+
+
+if __name__ == "__main__":
+    main()

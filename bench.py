@@ -111,13 +111,14 @@ def run(model_name, batch_size, steps, backend, image_size, reps, feed,
 
     from sav_tpu.data import synthetic_data_iterator
 
-    # The savrec path never mixes on the host, so its device_preprocess
-    # trainer must not mix either — otherwise the A/B conflates "moved
-    # normalize to device" with "added CutMix/MixUp the baseline lacks".
-    # The tf.data feed mixes on both sides (host mixes vs device mixes).
+    # Keep both A/B arms doing the same work: the savrec path never mixes
+    # on the host, so its device_preprocess trainer must not mix either;
+    # the tf.data feed mixes on both sides (host mixes vs device mixes),
+    # with the trainer's recipe pinned to the iterator's hard-coded
+    # augment_name rather than whatever TrainConfig defaults to.
     trainer = _make_trainer(
         model_name, batch_size, backend, image_size, device_preprocess,
-        augment="none" if feed == "savrec" else None,
+        augment="none" if feed == "savrec" else "cutmix_mixup_randaugment_405",
     )
     state = trainer.init_state()
     rng = jax.random.PRNGKey(0)

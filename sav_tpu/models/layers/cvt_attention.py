@@ -25,6 +25,7 @@ import jax
 import jax.numpy as jnp
 
 from sav_tpu.models.layers.attention import talking_heads_attention
+from sav_tpu.models.layers.depthwise import DepthwiseConv2D
 from sav_tpu.ops.attention import dot_product_attention
 
 Dtype = Any
@@ -56,13 +57,12 @@ class ConvProjectionBlock(nn.Module):
         else:
             cls_tok, grid_tokens = None, tokens
         x = grid_tokens.reshape(b, h, w, ch)
-        x = nn.Conv(
+        # Shifted-FMA depthwise (param-compatible with the nn.Conv grouped
+        # form; see layers/depthwise.py for why not feature_group_count).
+        x = DepthwiseConv2D(
             features=ch,
             kernel_size=self.kernel_size,
-            strides=(self.stride, self.stride),
-            padding="SAME",
-            feature_group_count=ch,
-            use_bias=False,
+            stride=self.stride,
             dtype=self.dtype,
             name="depthwise",
         )(x)

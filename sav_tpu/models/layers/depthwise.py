@@ -1,0 +1,81 @@
+"""Depthwise 2-D convolution as unrolled shifted multiply-adds.
+
+TPU-first formulation: a depthwise convolution contracts nothing across
+channels, so it cannot use the MXU — it is VPU elementwise work no matter
+how it is written. Expressing it as k² pad→strided-slice→FMA taps gives
+XLA trivially fusible elementwise ops instead of a grouped-convolution op,
+which this environment's TPU compiler lowers pathologically slowly
+(a single `nn.Conv(feature_group_count=C)` 3×3 block took >10 min to
+compile on-chip while the whole rest of the model zoo compiles in seconds
+— PERF.md §8). FLOPs and numerics are identical (k² products per output,
+f32 accumulation, SAME zero-padding).
+
+Parameter layout (`kernel`: ``[kh, kw, 1, C]``, module-scoped name
+unchanged) matches ``nn.Conv(features=C, feature_group_count=C,
+use_bias=False)`` exactly, so existing checkpoints interchange and the
+initialization distribution (lecun_normal fans from the same shape) is
+identical.
+
+Consumers: CvT conv projections (cvt_attention.py, reference
+cvt_attention.py:12-120) and CeiT LeFF (feedforward.py, reference
+leff.py semantics).
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+Dtype = Any
+
+
+def _same_pad(size: int, k: int, s: int) -> tuple[int, tuple[int, int]]:
+    """TF/XLA 'SAME' geometry: out = ceil(size/s), pad split low/high."""
+    out = -(-size // s)
+    total = max((out - 1) * s + k - size, 0)
+    return out, (total // 2, total - total // 2)
+
+
+class DepthwiseConv2D(nn.Module):
+    """``[B, H, W, C] -> [B, H', W', C]`` depthwise conv, SAME padding."""
+
+    features: int
+    kernel_size: tuple[int, int] = (3, 3)
+    stride: int = 1
+    dtype: Dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x: jax.Array) -> jax.Array:
+        kh, kw = self.kernel_size
+        kernel = self.param(
+            "kernel",
+            nn.initializers.lecun_normal(),
+            (kh, kw, 1, self.features),
+            jnp.float32,
+        )
+        s = self.stride
+        out_h, (ph0, ph1) = _same_pad(x.shape[1], kh, s)
+        out_w, (pw0, pw1) = _same_pad(x.shape[2], kw, s)
+        xp = jnp.pad(
+            x.astype(self.dtype), ((0, 0), (ph0, ph1), (pw0, pw1), (0, 0))
+        )
+        acc = None
+        for di in range(kh):
+            for dj in range(kw):
+                tap = jax.lax.slice(
+                    xp,
+                    (0, di, dj, 0),
+                    (
+                        xp.shape[0],
+                        di + (out_h - 1) * s + 1,
+                        dj + (out_w - 1) * s + 1,
+                        xp.shape[3],
+                    ),
+                    (1, s, s, 1),
+                )
+                term = tap.astype(jnp.float32) * kernel[di, dj, 0]
+                acc = term if acc is None else acc + term
+        return acc.astype(self.dtype)

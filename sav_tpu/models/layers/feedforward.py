@@ -12,6 +12,8 @@ import flax.linen as nn
 import jax
 import jax.numpy as jnp
 
+from sav_tpu.models.layers.depthwise import DepthwiseConv2D
+
 Dtype = Any
 
 
@@ -67,12 +69,11 @@ class LeFFBlock(nn.Module):
         x = nn.Dense(hidden, dtype=self.dtype, name="expand")(tokens)
         x = self.activation_fn(norm("bn1")(x))
         x = x.reshape(b, side, side, hidden)
-        x = nn.Conv(
+        # Shifted-FMA depthwise (param-compatible with the nn.Conv grouped
+        # form; see layers/depthwise.py for why not feature_group_count).
+        x = DepthwiseConv2D(
             features=hidden,
             kernel_size=self.kernel_size,
-            padding="SAME",
-            feature_group_count=hidden,
-            use_bias=False,
             dtype=self.dtype,
             name="dwconv",
         )(x)

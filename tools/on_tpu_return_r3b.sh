@@ -1,0 +1,86 @@
+#!/bin/bash
+# Second-half-of-round-3 queue: poll the TPU relay; when it answers, run
+# the remaining on-chip validations. Outputs land in .tpu_results/.
+set -u
+cd /root/repo
+mkdir -p .tpu_results
+LOG=.tpu_results/r3b_log
+
+probe() {
+  timeout 90 python -u -c "
+import jax, jax.numpy as jnp
+assert jax.devices()[0].platform != 'cpu', jax.devices()
+print(jax.device_get((jnp.ones((256,256),jnp.bfloat16)@jnp.ones((256,256),jnp.bfloat16)).sum()))
+" >/dev/null 2>&1
+}
+
+echo "$(date) polling for TPU relay" > "$LOG"
+until probe; do
+  sleep 180
+done
+echo "$(date) TPU is back — running r3b battery" >> "$LOG"
+
+run() {  # run <name> <timeout_s> <cmd...>
+  local name=$1 t=$2; shift 2
+  echo "$(date) START $name" >> "$LOG"
+  timeout "$t" "$@" > ".tpu_results/$name.out" 2>&1
+  local rc=$?  # captured before the $(date) substitution can clobber $?
+  echo "$(date) DONE $name (rc=$rc)" >> "$LOG"
+}
+
+# 1. Device-preprocess functional drive (train loss decreases on chip).
+run devpp_drive 1800 env PYTHONPATH=/root/repo:/root/.axon_site python - <<'EOF'
+import numpy as np, jax, jax.numpy as jnp
+from sav_tpu.train import TrainConfig, Trainer
+from sav_tpu.models import create_model
+config = TrainConfig(
+    model_name="vit_ti_patch16", num_classes=10, image_size=48,
+    compute_dtype="bfloat16", global_batch_size=64, num_train_images=256,
+    num_epochs=2, warmup_epochs=1, transpose_images=False,
+    augment="cutmix_mixup", device_preprocess=True, base_lr=0.016, seed=0)
+model = create_model("vit_ti_patch16", num_classes=10, patch_shape=(8, 8), dtype=jnp.bfloat16)
+trainer = Trainer(config, model=model)
+rng = np.random.default_rng(0)
+labels = rng.integers(0, 10, (64,))
+images = (labels[:, None, None, None] * 20 + rng.integers(0, 40, (64, 48, 48, 3))).clip(0, 255).astype(np.uint8)
+batch = {"images": images, "labels": labels.astype(np.int32)}
+state = trainer.init_state(0)
+losses = []
+for i in range(25):
+    state, m = trainer.train_step(state, batch, jax.random.PRNGKey(0))
+    losses.append(float(jax.device_get(m["loss"])))
+print("first/last loss:", round(losses[0], 3), round(losses[-1], 3))
+em = trainer.eval_step(state, batch)
+assert np.isfinite(float(jax.device_get(em["loss_sum"])))
+assert losses[-1] < losses[0]
+print("device-preprocess train+eval on real TPU: OK")
+EOF
+
+# 2. savrec fed A/B: host finishing vs device preprocessing.
+run bench_savrec_host 1500 python bench.py --feed savrec --steps 6
+run bench_savrec_devpp 1500 python bench.py --feed savrec --steps 6 --device-preprocess
+
+# 3. Remaining zoo families on real hardware (cvt probed separately —
+#    known pathological XLA-TPU compile, see zoo notes).
+run zoo_rest 5400 env PYTHONPATH=/root/repo:/root/.axon_site python tools/zoo_tpu_check.py --only ceit
+run zoo_tnt 5400 env PYTHONPATH=/root/repo:/root/.axon_site python tools/zoo_tpu_check.py --only tnt
+run zoo_botnet 5400 env PYTHONPATH=/root/repo:/root/.axon_site python tools/zoo_tpu_check.py --only botnet
+run zoo_mixer 2700 env PYTHONPATH=/root/repo:/root/.axon_site python tools/zoo_tpu_check.py --only mixer
+
+# 4. cvt compile probe with a generous budget at reduced size for signal.
+run cvt_probe 5400 env PYTHONPATH=/root/repo:/root/.axon_site python - <<'EOF'
+import time, jax, jax.numpy as jnp
+from sav_tpu.models import create_model
+t0 = time.time()
+x = jax.random.normal(jax.random.PRNGKey(0), (2, 96, 96, 3), jnp.bfloat16)
+model = create_model("cvt-13", num_classes=10, dtype=jnp.bfloat16)
+v = model.init({"params": jax.random.PRNGKey(0)}, x, is_training=False)
+out = jax.jit(lambda v, x: model.apply(v, x, is_training=False))(v, x)
+out.block_until_ready()
+print(f"cvt-13 fwd @96^2 compile+run: {time.time()-t0:.0f}s")
+EOF
+
+# 5. Headline bench for the record at current defaults.
+run bench_final 1500 python bench.py
+
+echo "$(date) r3b battery complete" >> "$LOG"

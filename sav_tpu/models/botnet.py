@@ -71,6 +71,7 @@ class BoTBlock(nn.Module):
     strides: int = 1
     activation_fn: Callable = nn.swish
     backend: Optional[str] = None
+    logits_dtype: Optional[Dtype] = None  # None = inherit dtype (softmax math)
     dtype: Dtype = jnp.float32
 
     @nn.compact
@@ -93,6 +94,7 @@ class BoTBlock(nn.Module):
             num_heads=self.num_heads,
             head_ch=self.filters // self.num_heads,
             backend=self.backend,
+            logits_dtype=self.logits_dtype,
             dtype=self.dtype,
             name="mhsa",
         )(x)
@@ -114,6 +116,7 @@ class BoTNet(nn.Module):
     se_ratio: Optional[float] = 0.25
     activation_fn: Callable = nn.swish
     backend: Optional[str] = None
+    logits_dtype: Optional[Dtype] = None  # None = inherit dtype (softmax math)
     dtype: Dtype = jnp.float32
 
     @nn.compact
@@ -147,6 +150,7 @@ class BoTNet(nn.Module):
                 strides=2 if block == 0 else 1,
                 activation_fn=self.activation_fn,
                 backend=self.backend,
+                logits_dtype=self.logits_dtype,
                 dtype=self.dtype,
                 name=f"stage4_block{block}",
             )(x, is_training)

@@ -38,6 +38,7 @@ class EncoderBlock(nn.Module):
     attn_dropout_rate: float = 0.0
     dropout_rate: float = 0.0
     backend: Optional[str] = None
+    logits_dtype: Optional[Dtype] = None  # None = inherit dtype (softmax math)
     dtype: Dtype = jnp.float32
 
     @nn.compact
@@ -49,6 +50,7 @@ class EncoderBlock(nn.Module):
             attn_dropout_rate=self.attn_dropout_rate,
             out_dropout_rate=self.dropout_rate,
             backend=self.backend,
+            logits_dtype=self.logits_dtype,
             dtype=self.dtype,
         )(x, is_training)
         x = LayerScaleBlock(eps=self.layerscale_eps, dtype=self.dtype)(x)
@@ -75,6 +77,7 @@ class CAEncoderBlock(nn.Module):
     attn_dropout_rate: float = 0.0
     dropout_rate: float = 0.0
     backend: Optional[str] = None
+    logits_dtype: Optional[Dtype] = None  # None = inherit dtype (softmax math)
     dtype: Dtype = jnp.float32
 
     @nn.compact
@@ -88,6 +91,7 @@ class CAEncoderBlock(nn.Module):
             attn_dropout_rate=self.attn_dropout_rate,
             out_dropout_rate=self.dropout_rate,
             backend=self.backend,
+            logits_dtype=self.logits_dtype,
             dtype=self.dtype,
         )(x, is_training)
         x = LayerScaleBlock(eps=self.layerscale_eps, dtype=self.dtype)(x)
@@ -117,6 +121,7 @@ class CaiT(nn.Module):
     attn_dropout_rate: float = 0.0
     dropout_rate: float = 0.0
     backend: Optional[str] = None
+    logits_dtype: Optional[Dtype] = None  # None = inherit dtype (softmax math)
     dtype: Dtype = jnp.float32
 
     @nn.compact
@@ -135,6 +140,7 @@ class CaiT(nn.Module):
                 attn_dropout_rate=self.attn_dropout_rate,
                 dropout_rate=self.dropout_rate,
                 backend=self.backend,
+                logits_dtype=self.logits_dtype,
                 dtype=self.dtype,
                 name=f"block_{i}",
             )(x, is_training)
@@ -153,6 +159,7 @@ class CaiT(nn.Module):
                 attn_dropout_rate=self.attn_dropout_rate,
                 dropout_rate=self.dropout_rate,
                 backend=self.backend,
+                logits_dtype=self.logits_dtype,
                 dtype=self.dtype,
                 name=f"ca_block_{i}",
             )(cls_tok, x, is_training)

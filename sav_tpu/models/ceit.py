@@ -36,6 +36,7 @@ class EncoderBlock(nn.Module):
     attn_dropout_rate: float = 0.0
     dropout_rate: float = 0.0
     backend: Optional[str] = None
+    logits_dtype: Optional[Dtype] = None  # None = inherit dtype (softmax math)
     dtype: Dtype = jnp.float32
 
     @nn.compact
@@ -45,6 +46,7 @@ class EncoderBlock(nn.Module):
             attn_dropout_rate=self.attn_dropout_rate,
             out_dropout_rate=self.dropout_rate,
             backend=self.backend,
+            logits_dtype=self.logits_dtype,
             dtype=self.dtype,
         )(inputs, is_training)
         x = nn.LayerNorm(dtype=self.dtype)(x + inputs)
@@ -63,6 +65,7 @@ class CeiT(nn.Module):
     attn_dropout_rate: float = 0.0
     dropout_rate: float = 0.0
     backend: Optional[str] = None
+    logits_dtype: Optional[Dtype] = None  # None = inherit dtype (softmax math)
     dtype: Dtype = jnp.float32
 
     @nn.compact
@@ -88,6 +91,7 @@ class CeiT(nn.Module):
                 attn_dropout_rate=self.attn_dropout_rate,
                 dropout_rate=self.dropout_rate,
                 backend=self.backend,
+                logits_dtype=self.logits_dtype,
                 dtype=self.dtype,
                 name=f"block_{i}",
             )(x, is_training)
@@ -100,6 +104,7 @@ class CeiT(nn.Module):
             num_heads=self.num_heads,
             attn_dropout_rate=self.attn_dropout_rate,
             backend=self.backend,
+            logits_dtype=self.logits_dtype,
             dtype=self.dtype,
             name="lca",
         )(cls_seq, is_training)

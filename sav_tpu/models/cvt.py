@@ -53,6 +53,7 @@ class StageBlock(nn.Module):
     attn_dropout_rate: float = 0.0
     dropout_rate: float = 0.0
     backend: Optional[str] = None
+    logits_dtype: Optional[Dtype] = None  # None = inherit dtype (softmax math)
     dtype: Dtype = jnp.float32
 
     @nn.compact
@@ -66,6 +67,7 @@ class StageBlock(nn.Module):
             attn_dropout_rate=self.attn_dropout_rate,
             out_dropout_rate=self.dropout_rate,
             backend=self.backend,
+            logits_dtype=self.logits_dtype,
             dtype=self.dtype,
         )(x, grid_shape, is_training)
         tokens = tokens + x
@@ -91,6 +93,7 @@ class Stage(nn.Module):
     attn_dropout_rate: float = 0.0
     dropout_rate: float = 0.0
     backend: Optional[str] = None
+    logits_dtype: Optional[Dtype] = None  # None = inherit dtype (softmax math)
     dtype: Dtype = jnp.float32
 
     @nn.compact
@@ -115,6 +118,7 @@ class Stage(nn.Module):
                 attn_dropout_rate=self.attn_dropout_rate,
                 dropout_rate=self.dropout_rate,
                 backend=self.backend,
+                logits_dtype=self.logits_dtype,
                 dtype=self.dtype,
                 name=f"block_{i}",
             )(tokens, grid_shape, is_training)
@@ -132,6 +136,7 @@ class CvT(nn.Module):
     attn_dropout_rate: float = 0.0
     dropout_rate: float = 0.0
     backend: Optional[str] = None
+    logits_dtype: Optional[Dtype] = None  # None = inherit dtype (softmax math)
     dtype: Dtype = jnp.float32
 
     @nn.compact
@@ -151,6 +156,7 @@ class CvT(nn.Module):
                 attn_dropout_rate=self.attn_dropout_rate,
                 dropout_rate=self.dropout_rate,
                 backend=self.backend,
+                logits_dtype=self.logits_dtype,
                 dtype=self.dtype,
                 name=f"stage_{s}",
             )(x, is_training)

@@ -158,6 +158,15 @@ class AttentionBlock(nn.Module):
     # broken, never-wired rotary path — SURVEY.md §2.9 #12).
     use_rotary: bool = False
     backend: Optional[str] = None  # None/'auto' | 'xla' | 'pallas'
+    logits_dtype: Optional[Dtype] = None  # None = inherit dtype (softmax math)
+    # Sequence parallelism: route the attention core through
+    # sav_tpu.parallel.seq_parallel over ``seq_mesh``'s 'seq' axis
+    # ('ring' | 'ulysses'; None = single-device core). Config-reachable via
+    # TrainConfig.sequence_parallel / train.py --sp N. Self-attention only,
+    # deterministic only (no attention dropout), exact numerics incl. the
+    # CLS-odd sequence lengths of the model zoo (pad-and-mask).
+    seq_parallel: Optional[str] = None
+    seq_mesh: Optional[Any] = None
     dtype: Dtype = jnp.float32
 
     @nn.compact
@@ -210,7 +219,53 @@ class AttentionBlock(nn.Module):
             key = apply_rotary_pos_emb(key, sincos)
 
         has_attn_dropout = self.attn_dropout_rate > 0.0 and is_training
-        if self.talking_heads:
+        if self.seq_parallel:
+            if self.talking_heads:
+                raise ValueError(
+                    "sequence parallelism does not compose with talking "
+                    "heads (head mixing couples heads across the sharded "
+                    "softmax); unset one of the two"
+                )
+            if has_attn_dropout:
+                raise ValueError(
+                    "sequence-parallel attention is deterministic-only; "
+                    "set attn_dropout_rate=0 (the reference recipes use "
+                    "stochastic depth + output dropout, not attention "
+                    "dropout)"
+                )
+            if inputs_q is not inputs_kv:
+                raise ValueError(
+                    "sequence parallelism supports self-attention blocks "
+                    "only (q and kv shards must cover the same sequence)"
+                )
+            if self.seq_mesh is None:
+                raise ValueError(
+                    "seq_parallel set but no seq_mesh given; pass the "
+                    "training Mesh (with a 'seq' axis) to the block"
+                )
+            if self.backend == "pallas":
+                raise ValueError(
+                    "seq_parallel runs the dense XLA core per shard; "
+                    "backend='pallas' is not routed under SP (the bare "
+                    "ring_attention/ulysses_attention ops expose flash "
+                    "mode for divisible lengths) — unset one of the two"
+                )
+            # logits_dtype does not apply here: online-softmax statistics
+            # (running max / denominator) are f32 by construction — see
+            # TrainConfig.sequence_parallel.
+            from sav_tpu.parallel.seq_parallel import (
+                sequence_parallel_attention,
+            )
+
+            out = sequence_parallel_attention(
+                query,
+                key,
+                value,
+                mesh=self.seq_mesh,
+                method=self.seq_parallel,
+                scale=scale,
+            )
+        elif self.talking_heads:
             from sav_tpu.ops.talking_heads import fused_eligible
 
             backend = self.backend or "auto"
@@ -265,6 +320,10 @@ class AttentionBlock(nn.Module):
                 )
         else:
             dropout_rng = self.make_rng("dropout") if has_attn_dropout else None
+            # Resolved HERE (None = this block's compute dtype — the
+            # reference's semantics: its logits einsum runs in the model
+            # dtype, attention.py:41-48) so no jitted path ever reads the
+            # deprecated process-wide default in sav_tpu.ops.attention.
             out = dot_product_attention(
                 query,
                 key,
@@ -274,6 +333,7 @@ class AttentionBlock(nn.Module):
                 dropout_rng=dropout_rng,
                 deterministic=not is_training,
                 backend=self.backend,
+                logits_dtype=self.logits_dtype or self.dtype,
             )
 
         out = nn.DenseGeneral(

@@ -37,6 +37,7 @@ class BoTMHSA(nn.Module):
     head_ch: Optional[int] = None
     pos_emb_init_stddev: Optional[float] = None
     backend: Optional[str] = None
+    logits_dtype: Optional[Dtype] = None  # None = inherit dtype (softmax math)
     dtype: Dtype = jnp.float32
 
     @nn.compact
@@ -96,6 +97,9 @@ class BoTMHSA(nn.Module):
             )
             bias = bias.reshape(b, self.num_heads, height * width, height * width)
             out = dot_product_attention(
-                query, key, value, bias=bias, scale=scale, backend="xla"
+                query, key, value, bias=bias, scale=scale, backend="xla",
+                # None = this block's compute dtype; resolved here so no
+                # jitted path reads the deprecated process-wide default.
+                logits_dtype=self.logits_dtype or self.dtype,
             )
         return out.reshape(b, height, width, inner)

@@ -94,6 +94,7 @@ class CvTAttentionBlock(nn.Module):
     use_bias: bool = False
     with_cls: bool = False
     backend: Optional[str] = None
+    logits_dtype: Optional[Dtype] = None  # None = inherit dtype (softmax math)
     dtype: Dtype = jnp.float32
 
     @nn.compact
@@ -141,6 +142,9 @@ class CvTAttentionBlock(nn.Module):
                 dropout_rng=dropout_rng,
                 deterministic=not is_training,
                 backend=self.backend,
+                # None = this block's compute dtype; resolved here so no
+                # jitted path reads the deprecated process-wide default.
+                logits_dtype=self.logits_dtype or self.dtype,
             )
 
         out = nn.DenseGeneral(

@@ -157,6 +157,9 @@ def create_model(
     num_classes: int = 1000,
     dtype=jnp.float32,
     backend: Optional[str] = None,
+    logits_dtype=None,
+    seq_parallel: Optional[str] = None,
+    seq_mesh=None,
     **overrides,
 ):
     """Instantiate a named model config.
@@ -167,6 +170,14 @@ def create_model(
       dtype: compute dtype (params stay fp32).
       backend: attention backend ('xla' | 'pallas' | None=auto) threaded to
         every attention block.
+      logits_dtype: softmax dtype for the XLA attention path, threaded to
+        every attention block (None = inherit ``dtype``, the reference's
+        semantics; 'float32' forces f32 softmax under bf16 compute).
+      seq_parallel: 'ring' | 'ulysses' — route self-attention through
+        sequence parallelism over ``seq_mesh``'s 'seq' axis (ViT family;
+        sav_tpu.parallel.seq_parallel).
+      seq_mesh: the jax.sharding.Mesh carrying the 'seq' axis; required
+        with ``seq_parallel``.
       **overrides: per-call hyperparameter overrides.
     """
     if model_name not in _REGISTRY:
@@ -178,6 +189,16 @@ def create_model(
     # Attention-free models (MLP-Mixer) have no backend seam — skip injection.
     if backend is not None and "backend" in cls.__dataclass_fields__:
         merged["backend"] = backend
+    if logits_dtype is not None and "logits_dtype" in cls.__dataclass_fields__:
+        merged["logits_dtype"] = logits_dtype
+    if seq_parallel is not None:
+        if "seq_parallel" not in cls.__dataclass_fields__:
+            raise ValueError(
+                f"{model_name!r} does not support sequence parallelism "
+                "(ViT-family self-attention models only)"
+            )
+        merged["seq_parallel"] = seq_parallel
+        merged["seq_mesh"] = seq_mesh
     return cls(**merged)
 
 

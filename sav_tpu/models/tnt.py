@@ -85,6 +85,7 @@ class EncoderBlock(nn.Module):
     attn_dropout_rate: float = 0.0
     dropout_rate: float = 0.0
     backend: Optional[str] = None
+    logits_dtype: Optional[Dtype] = None  # None = inherit dtype (softmax math)
     dtype: Dtype = jnp.float32
 
     @nn.compact
@@ -98,6 +99,7 @@ class EncoderBlock(nn.Module):
             attn_dropout_rate=self.attn_dropout_rate,
             out_dropout_rate=self.dropout_rate,
             backend=self.backend,
+            logits_dtype=self.logits_dtype,
             dtype=self.dtype,
             name="inner_attn",
         )(x, is_training)
@@ -122,6 +124,7 @@ class EncoderBlock(nn.Module):
             attn_dropout_rate=self.attn_dropout_rate,
             out_dropout_rate=self.dropout_rate,
             backend=self.backend,
+            logits_dtype=self.logits_dtype,
             dtype=self.dtype,
             name="outer_attn",
         )(z, is_training)
@@ -151,6 +154,7 @@ class TNT(nn.Module):
     attn_dropout_rate: float = 0.0
     dropout_rate: float = 0.0
     backend: Optional[str] = None
+    logits_dtype: Optional[Dtype] = None  # None = inherit dtype (softmax math)
     dtype: Dtype = jnp.float32
 
     @nn.compact
@@ -189,6 +193,7 @@ class TNT(nn.Module):
                 attn_dropout_rate=self.attn_dropout_rate,
                 dropout_rate=self.dropout_rate,
                 backend=self.backend,
+                logits_dtype=self.logits_dtype,
                 dtype=self.dtype,
                 name=f"block_{i}",
             )(pixel_tokens, patch_tokens, is_training)

@@ -36,6 +36,9 @@ class EncoderBlock(nn.Module):
     moe_top_k: int = 2
     use_rotary: bool = False
     backend: Optional[str] = None
+    logits_dtype: Optional[Dtype] = None  # None = inherit dtype (softmax math)
+    seq_parallel: Optional[str] = None  # 'ring'|'ulysses' over seq_mesh
+    seq_mesh: Optional[Any] = None
     dtype: Dtype = jnp.float32
 
     @nn.compact
@@ -47,6 +50,9 @@ class EncoderBlock(nn.Module):
             out_dropout_rate=self.dropout_rate,
             use_rotary=self.use_rotary,
             backend=self.backend,
+            logits_dtype=self.logits_dtype,
+            seq_parallel=self.seq_parallel,
+            seq_mesh=self.seq_mesh,
             dtype=self.dtype,
         )(x, is_training)
         x = x + inputs
@@ -89,6 +95,9 @@ class Encoder(nn.Module):
     # length is HBM-bound.
     remat: bool = False
     backend: Optional[str] = None
+    logits_dtype: Optional[Dtype] = None  # None = inherit dtype (softmax math)
+    seq_parallel: Optional[str] = None  # 'ring'|'ulysses' over seq_mesh
+    seq_mesh: Optional[Any] = None
     dtype: Dtype = jnp.float32
 
     @nn.compact
@@ -121,6 +130,9 @@ class Encoder(nn.Module):
                 moe_top_k=self.moe_top_k,
                 use_rotary=self.pos_embed == "rotary",
                 backend=self.backend,
+                logits_dtype=self.logits_dtype,
+                seq_parallel=self.seq_parallel,
+                seq_mesh=self.seq_mesh,
                 dtype=self.dtype,
                 name=f"block_{i}",
             )(x, is_training)
@@ -144,6 +156,9 @@ class ViT(nn.Module):
     pos_embed: str = "learned"
     remat: bool = False  # see Encoder.remat
     backend: Optional[str] = None
+    logits_dtype: Optional[Dtype] = None  # None = inherit dtype (softmax math)
+    seq_parallel: Optional[str] = None  # 'ring'|'ulysses' over seq_mesh
+    seq_mesh: Optional[Any] = None
     dtype: Dtype = jnp.float32
 
     @nn.compact
@@ -167,6 +182,9 @@ class ViT(nn.Module):
             pos_embed=self.pos_embed,
             remat=self.remat,
             backend=self.backend,
+            logits_dtype=self.logits_dtype,
+            seq_parallel=self.seq_parallel,
+            seq_mesh=self.seq_mesh,
             dtype=self.dtype,
         )(x, is_training)
         cls_out = x[:, 0]

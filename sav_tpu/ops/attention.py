@@ -54,19 +54,26 @@ def _on_tpu() -> bool:
 # beyond it the XLA path thrashes or OOMs while flash stays O(L·D).
 _AUTO_PALLAS_LOGITS_BYTES = 2 << 30
 
-# Process-wide default for the XLA path's softmax dtype. f32 is the safe
-# reference; bf16 halves the dominant HBM traffic of the [B, H, L, L]
-# logits/probability tensors (PERF.md §5 — the attention core is
-# bandwidth-bound, not FLOP-bound, at model-zoo shapes) at ~2⁻⁸ relative
-# logit precision. Set via :func:`set_default_logits_dtype` (the Trainer
-# does this from ``TrainConfig.attention_logits_dtype``) BEFORE any jit
-# tracing: the value is baked into traces at trace time, and already-cached
-# executables do not notice later changes.
+# DEPRECATED process-wide fallback for the XLA path's softmax dtype, used
+# only when a caller passes ``logits_dtype=None`` to the bare functional
+# core. Every framework path resolves the dtype explicitly instead: the
+# attention *blocks* carry a ``logits_dtype`` attribute (None = the block's
+# compute dtype — the reference's semantics) threaded from
+# ``TrainConfig.attention_logits_dtype`` through ``create_model``, so no
+# jitted model path reads this module state. f32 is the safe raw-op
+# default; bf16 halves the dominant HBM traffic of the [B, H, L, L]
+# logits/probability tensors (PERF.md §5) at ~2⁻⁸ relative logit precision.
 _DEFAULT_LOGITS_DTYPE = jnp.float32
 
 
 def set_default_logits_dtype(dtype) -> None:
-    """Set the process-wide softmax dtype for the XLA attention path."""
+    """DEPRECATED: set the process-wide softmax dtype fallback.
+
+    Only affects direct :func:`xla_attention` / :func:`dot_product_attention`
+    calls that pass ``logits_dtype=None``. Model blocks resolve their dtype
+    from their own ``logits_dtype``/``dtype`` attributes and never consult
+    this. Prefer passing ``logits_dtype`` explicitly.
+    """
     global _DEFAULT_LOGITS_DTYPE
     _DEFAULT_LOGITS_DTYPE = jnp.dtype(dtype).type
 
@@ -106,6 +113,8 @@ def xla_attention(
         scale = query.shape[-1] ** -0.5
     if logits_dtype is None:
         logits_dtype = _DEFAULT_LOGITS_DTYPE
+    # Canonicalize: config-layer callers pass strings ('bfloat16').
+    logits_dtype = jnp.dtype(logits_dtype)
     probs = _softmax_probs(query, key, bias, scale, logits_dtype)
     if dropout_rate > 0.0 and not deterministic:
         if dropout_rng is None:
@@ -233,8 +242,15 @@ def dot_product_attention(
     dropout_rng: Optional[jax.Array] = None,
     deterministic: bool = True,
     backend: Optional[str] = None,
+    logits_dtype=None,
 ) -> jax.Array:
-    """Backend-dispatched attention. See module docstring."""
+    """Backend-dispatched attention. See module docstring.
+
+    ``logits_dtype`` sets the XLA path's softmax dtype (None = the
+    deprecated process-wide default, f32 unless configured). The Pallas
+    flash kernel always accumulates its running softmax in f32 on-chip and
+    ignores it.
+    """
     backend = backend or "auto"
     if backend not in ("auto", "xla", "pallas"):
         raise ValueError(f"unknown attention backend: {backend!r}")
@@ -267,4 +283,5 @@ def dot_product_attention(
         dropout_rate=dropout_rate,
         dropout_rng=dropout_rng,
         deterministic=deterministic,
+        logits_dtype=logits_dtype,
     )

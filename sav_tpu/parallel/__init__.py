@@ -17,6 +17,7 @@ from sav_tpu.parallel.pipelining import (
     stage_param_shardings,
 )
 from sav_tpu.parallel.ring_attention import ring_attention
+from sav_tpu.parallel.seq_parallel import sequence_parallel_attention
 from sav_tpu.parallel.ulysses import ulysses_attention
 from sav_tpu.parallel.sharding import (
     DEFAULT_EP_RULES,
@@ -49,5 +50,6 @@ __all__ = [
     "param_shardings",
     "shard_params",
     "ring_attention",
+    "sequence_parallel_attention",
     "ulysses_attention",
 ]

@@ -38,20 +38,45 @@ _fa = importlib.import_module("sav_tpu.ops.flash_attention")
 _NEG_INF = float("-inf")
 
 
-def _ring_shard_fn(q, k, v, *, axis_name: str, axis_size: int, scale: float):
-    """Per-shard body. q/k/v: ``[B, L_loc, H, D]`` (local shards)."""
+def _ring_shard_fn(q, k, v, *, axis_name: str, axis_size: int, scale: float,
+                   valid_len: Optional[int] = None):
+    """Per-shard body. q/k/v: ``[B, L_loc, H, D]`` (local shards).
+
+    ``valid_len`` (static) masks global key positions ``>= valid_len`` out
+    of every softmax — the pad-and-mask path :mod:`sav_tpu.parallel.seq_parallel`
+    uses for CLS-odd model sequence lengths. Each K/V block then travels
+    with its origin shard index (rotated along with the block) so global
+    positions stay recoverable after any number of ppermutes. ``None``
+    compiles to the unmasked loop (no extra ops).
+    """
     batch, q_len, heads, dim = q.shape
     m = jnp.full((batch, heads, q_len, 1), _NEG_INF, jnp.float32)
     l = jnp.zeros((batch, heads, q_len, 1), jnp.float32)
     acc = jnp.zeros((batch, q_len, heads, dim), jnp.float32)
+    masked = valid_len is not None
+    origin = jax.lax.axis_index(axis_name) if masked else None
 
-    def one_block(m, l, acc, k_blk, v_blk):
+    def one_block(m, l, acc, k_blk, v_blk, origin):
         s = jnp.einsum(
             "bqhd,bkhd->bhqk", q, k_blk, preferred_element_type=jnp.float32
         ) * scale
+        if masked:
+            key_pos = origin * k_blk.shape[1] + jax.lax.iota(
+                jnp.int32, k_blk.shape[1]
+            )
+            s = jnp.where(
+                key_pos[None, None, None, :] < valid_len, s, _NEG_INF
+            )
         m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
-        alpha = jnp.exp(m - m_new)
-        p = jnp.exp(s - m_new)
+        if masked:
+            # A fully-masked block leaves m at -inf; exp(-inf - -inf) = nan,
+            # so guard the shift (the block contributes exactly zero mass).
+            m_safe = jnp.where(jnp.isneginf(m_new), 0.0, m_new)
+            alpha = jnp.exp(jnp.where(jnp.isneginf(m), _NEG_INF, m - m_safe))
+            p = jnp.exp(s - m_safe)
+        else:
+            alpha = jnp.exp(m - m_new)
+            p = jnp.exp(s - m_new)
         l_new = alpha * l + jnp.sum(p, axis=-1, keepdims=True)
         pv = jnp.einsum(
             "bhqk,bkhd->bqhd", p.astype(v_blk.dtype), v_blk,
@@ -63,10 +88,17 @@ def _ring_shard_fn(q, k, v, *, axis_name: str, axis_size: int, scale: float):
 
     perm = [(j, (j + 1) % axis_size) for j in range(axis_size)]
     for step in range(axis_size):
-        m, l, acc = one_block(m, l, acc, k, v)
+        m, l, acc = one_block(m, l, acc, k, v, origin)
         if step + 1 < axis_size:
             k = jax.lax.ppermute(k, axis_name, perm)
             v = jax.lax.ppermute(v, axis_name, perm)
+            if masked:
+                origin = jax.lax.ppermute(origin, axis_name, perm)
+    if masked:
+        # Padded query rows have l == 0 (every key masked); emit zeros, not
+        # 0/0 — the caller slices them off, but NaNs would poison any
+        # reduction run over the raw output.
+        l = jnp.where(l == 0.0, 1.0, l)
     out = acc / jnp.transpose(l, (0, 2, 1, 3))
     return out.astype(q.dtype)
 

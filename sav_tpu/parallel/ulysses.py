@@ -31,8 +31,16 @@ from sav_tpu.parallel.mesh import SEQ_AXIS
 
 
 def _ulysses_shard_fn(q, k, v, *, axis_name: str, scale: float,
-                      backend: str = "xla"):
-    """Per-shard body. q/k/v: ``[B, L_loc, H, D]`` (sequence shards)."""
+                      backend: str = "xla",
+                      valid_len: Optional[int] = None):
+    """Per-shard body. q/k/v: ``[B, L_loc, H, D]`` (sequence shards).
+
+    ``valid_len`` (static, XLA backend only) masks key positions
+    ``>= valid_len`` — the pad-and-mask path
+    :mod:`sav_tpu.parallel.seq_parallel` uses for CLS-odd lengths; after
+    the all-to-all the whole (padded) sequence is local, so a plain iota
+    mask suffices.
+    """
 
     def seq_to_heads(x):
         # [B, L/n, H, D] → [B, L, H/n, D]: split heads across the axis
@@ -57,6 +65,11 @@ def _ulysses_shard_fn(q, k, v, *, axis_name: str, scale: float,
         s = jnp.einsum(
             "bqhd,bkhd->bhqk", q, k, preferred_element_type=jnp.float32
         ) * scale
+        if valid_len is not None:
+            key_pos = jax.lax.iota(jnp.int32, k.shape[1])
+            s = jnp.where(
+                key_pos[None, None, None, :] < valid_len, s, float("-inf")
+            )
         p = jax.nn.softmax(s, axis=-1).astype(v.dtype)
         out = jnp.einsum(
             "bhqk,bkhd->bqhd", p, v, preferred_element_type=jnp.float32

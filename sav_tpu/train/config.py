@@ -28,8 +28,12 @@ class TrainConfig:
     # dtype). Under bf16 compute this halves the dominant [B,H,L,L] HBM
     # traffic (−15% step time on v5e, PERF.md §6) at ~2⁻⁸ relative logit
     # precision; accuracy-gated by tools/logits_dtype_gate.py (identical
-    # final top-1 under f32 and bf16 compute). Set 'float32' to force f32
-    # softmax under bf16 compute.
+    # final top-1 under f32 and bf16 compute — gated on the 48² digits
+    # recipe only; re-gate on the first full-scale/197+-token run, where
+    # bf16 softmax error compounds over more steps). Set 'float32' to
+    # force f32 softmax under bf16 compute. Threaded as a model attribute
+    # (create_model(..., logits_dtype=...)); ignored when Trainer is
+    # handed an externally built model, which carries its own setting.
     attention_logits_dtype: Optional[str] = None
     # Extra kwargs for create_model (e.g. {'remat': True} to rematerialize
     # encoder blocks when activations are HBM-bound, or architecture
@@ -74,6 +78,16 @@ class TrainConfig:
 
     # Mesh: axis name -> size (-1 absorbs remaining devices)
     mesh_axes: Optional[dict] = None
+    # Sequence parallelism: 'ring' | 'ulysses' routes every self-attention
+    # core through sav_tpu.parallel.seq_parallel over the mesh's 'seq'
+    # axis (mesh_axes must include it; train.py --sp N builds both).
+    # Exact numerics incl. CLS-odd lengths (pad-and-mask); self-attention
+    # models only, deterministic attention only. Under SP the softmax
+    # statistics are always f32 (an online-softmax requirement), so
+    # attention_logits_dtype='bfloat16' does not apply, and the per-shard
+    # core is dense XLA (attention_backend='pallas' is rejected; the bare
+    # parallel.ring_attention op exposes flash mode for divisible lengths).
+    sequence_parallel: Optional[str] = None
 
     # Logging / checkpointing
     eval_every_epochs: int = 5

@@ -61,22 +61,27 @@ class Trainer:
             self._mix_spec = parse_augment_spec(config.augment)
         else:
             self._mix_spec = None
-        # Set unconditionally (None = f32) so one Trainer's bf16 setting
-        # can't leak into the next Trainer built in the same process. Must
-        # happen before any jit tracing of the model — the default is baked
-        # into traces at trace time.
-        from sav_tpu.ops.attention import set_default_logits_dtype
+        # The softmax dtype is a *model attribute*, not process state:
+        # attention blocks resolve ``logits_dtype or dtype`` themselves, so
+        # two trainers with different settings coexist structurally (no
+        # re-pinning around lazy traces). None inherits the compute dtype —
+        # exactly the reference's semantics (its logits einsum runs in the
+        # model dtype, attention.py:41-48, so a bf16 reference run has bf16
+        # logits). Accuracy-gated both ways (tools/logits_dtype_gate.py:
+        # identical final top-1 under f32 and bf16 compute) and measured
+        # −15% step time on v5e (PERF.md §6). Force 'float32' for f32
+        # softmax under bf16 compute. An externally passed ``model``
+        # carries its own attributes; config.attention_logits_dtype does
+        # not apply to it.
+        if config.sequence_parallel:
+            from sav_tpu.parallel.mesh import SEQ_AXIS
 
-        # None inherits the compute dtype — exactly the reference's
-        # semantics (its logits einsum runs in the model dtype,
-        # attention.py:41-48, so a bf16 reference run has bf16 logits).
-        # Accuracy-gated both ways (tools/logits_dtype_gate.py: identical
-        # final top-1 under f32 and bf16 compute) and measured −15% step
-        # time on v5e (PERF.md §6). Force 'float32' for f32 softmax
-        # under bf16 compute.
-        set_default_logits_dtype(
-            config.attention_logits_dtype or config.compute_dtype
-        )
+            if SEQ_AXIS not in self.mesh.axis_names:
+                raise ValueError(
+                    f"sequence_parallel={config.sequence_parallel!r} needs a "
+                    f"'{SEQ_AXIS}' mesh axis; got {self.mesh.axis_names} "
+                    "(set mesh_axes={'data': -1, 'seq': N} or train.py --sp N)"
+                )
         self.model = (
             model
             if model is not None
@@ -85,6 +90,11 @@ class Trainer:
                 num_classes=config.num_classes,
                 dtype=self.compute_dtype,
                 backend=config.attention_backend,
+                logits_dtype=config.attention_logits_dtype,
+                # SP threads the trainer's mesh into every attention block
+                # (the blocks shard_map their q/k/v over its 'seq' axis).
+                seq_parallel=config.sequence_parallel,
+                seq_mesh=self.mesh if config.sequence_parallel else None,
                 **(config.model_overrides or {}),
             )
         )
@@ -111,34 +121,9 @@ class Trainer:
             self.checkpointer = Checkpointer(
                 config.checkpoint_dir, keep=config.checkpoint_keep
             )
-        self._train_step = self._pin_logits_dtype(
-            jax.jit(self._train_step_impl, donate_argnums=(0,))
-        )
-        self._train_many = self._pin_logits_dtype(
-            jax.jit(self._train_many_impl, donate_argnums=(0,))
-        )
-        self._eval_step = self._pin_logits_dtype(jax.jit(self._eval_step_impl))
-
-    def _pin_logits_dtype(self, jitted):
-        """Re-assert this trainer's softmax dtype before every call/lower.
-
-        The dtype lives in a process-wide default that another Trainer in
-        the same process may have changed; tracing is lazy, so without this
-        a step first traced *after* that change would silently bake in the
-        other trainer's dtype. Exposes ``lower`` for the AOT paths."""
-        dtype = self.config.attention_logits_dtype or self.config.compute_dtype
-        from sav_tpu.ops.attention import set_default_logits_dtype
-
-        def call(*args, **kwargs):
-            set_default_logits_dtype(dtype)
-            return jitted(*args, **kwargs)
-
-        def lower(*args, **kwargs):
-            set_default_logits_dtype(dtype)
-            return jitted.lower(*args, **kwargs)
-
-        call.lower = lower
-        return call
+        self._train_step = jax.jit(self._train_step_impl, donate_argnums=(0,))
+        self._train_many = jax.jit(self._train_many_impl, donate_argnums=(0,))
+        self._eval_step = jax.jit(self._eval_step_impl)
 
     # ------------------------------------------------------------------ init
 

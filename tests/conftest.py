@@ -19,6 +19,14 @@ jax.config.update("jax_platforms", "cpu")
 import pytest
 
 
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers",
+        "slow: expensive mesh/pipeline/records tests; deselect with "
+        "-m 'not slow' for the fast tier (<5 min on one core)",
+    )
+
+
 @pytest.fixture(scope="session")
 def devices():
     devs = jax.devices()

@@ -1,0 +1,160 @@
+"""Config-reachable sequence parallelism (VERDICT r3 item 5).
+
+Covers the model seam :mod:`sav_tpu.parallel.seq_parallel` (pad-and-mask
+routing into ring/Ulysses), the ``AttentionBlock(seq_parallel=...)`` wiring,
+and the TrainConfig path — numerics pinned against the unsharded dense core
+on the 8-device CPU mesh, including CLS-odd sequence lengths.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from sav_tpu.models import create_model
+from sav_tpu.ops.attention import xla_attention
+from sav_tpu.parallel import create_mesh, sequence_parallel_attention
+from sav_tpu.train import TrainConfig, Trainer
+
+
+def _qkv(b=2, l=17, h=4, d=8, dtype=jnp.float32, seed=0):
+    ks = jax.random.split(jax.random.PRNGKey(seed), 3)
+    return tuple(jax.random.normal(k, (b, l, h, d), dtype) for k in ks)
+
+
+@pytest.mark.parametrize("method", ["ring", "ulysses"])
+@pytest.mark.parametrize("length", [16, 17])  # divisible and CLS-odd (pad)
+def test_wrapper_matches_dense(devices, method, length):
+    mesh = create_mesh({"data": 4, "seq": 2})
+    q, k, v = _qkv(l=length)
+    want = np.asarray(xla_attention(q, k, v), np.float32)
+    got = np.asarray(
+        sequence_parallel_attention(q, k, v, mesh=mesh, method=method),
+        np.float32,
+    )
+    np.testing.assert_allclose(got, want, atol=2e-6, rtol=2e-6)
+
+
+def test_wrapper_grads_match_dense(devices):
+    mesh = create_mesh({"data": 4, "seq": 2})
+    q, k, v = _qkv(l=17)
+
+    def dense_loss(q, k, v):
+        return jnp.mean(xla_attention(q, k, v) ** 2)
+
+    def sp_loss(q, k, v):
+        return jnp.mean(
+            sequence_parallel_attention(q, k, v, mesh=mesh, method="ring") ** 2
+        )
+
+    want = jax.grad(dense_loss, argnums=(0, 1, 2))(q, k, v)
+    got = jax.grad(sp_loss, argnums=(0, 1, 2))(q, k, v)
+    for g, w in zip(got, want):
+        np.testing.assert_allclose(
+            np.asarray(g, np.float32), np.asarray(w, np.float32),
+            atol=5e-6, rtol=5e-6,
+        )
+
+
+def test_ulysses_rejects_indivisible_heads(devices):
+    mesh = create_mesh({"data": 2, "seq": 4})
+    q, k, v = _qkv(h=6)  # 6 % 4 != 0
+    with pytest.raises(ValueError, match="divisible"):
+        sequence_parallel_attention(q, k, v, mesh=mesh, method="ulysses")
+
+
+@pytest.mark.parametrize("method", ["ring", "ulysses"])
+def test_sp_vit_forward_matches_unsharded(devices, method):
+    """A 2-way-SP ViT forward equals the plain forward on the same params —
+    the acceptance test VERDICT r3 item 5 names. 32² p8 → 17 tokens, so the
+    pad-and-mask path is what runs."""
+    mesh = create_mesh({"data": 4, "seq": 2})
+    kwargs = dict(
+        num_classes=10, num_layers=2, embed_dim=64, num_heads=4,
+        patch_shape=(8, 8),
+    )
+    dense = create_model("vit_ti_patch16", **kwargs)
+    sp = create_model(
+        "vit_ti_patch16", seq_parallel=method, seq_mesh=mesh, **kwargs
+    )
+    x = jax.random.normal(jax.random.PRNGKey(0), (4, 32, 32, 3), jnp.float32)
+    variables = dense.init({"params": jax.random.PRNGKey(1)}, x, is_training=False)
+    # Zero-init head makes fresh logits vacuously equal — randomize it.
+    variables = jax.tree.map(lambda a: a, variables)  # unfreeze-safe copy
+    head = variables["params"]["head"]["kernel"]
+    variables["params"]["head"]["kernel"] = jax.random.normal(
+        jax.random.PRNGKey(2), head.shape, head.dtype
+    )
+    want = np.asarray(dense.apply(variables, x, is_training=False), np.float32)
+    got = np.asarray(
+        jax.jit(lambda v, x: sp.apply(v, x, is_training=False))(variables, x),
+        np.float32,
+    )
+    np.testing.assert_allclose(got, want, atol=1e-5, rtol=1e-5)
+
+
+def test_sp_model_requires_mesh(devices):
+    with pytest.raises(ValueError, match="seq_mesh"):
+        m = create_model(
+            "vit_ti_patch16", num_classes=10, num_layers=1, embed_dim=32,
+            num_heads=2, patch_shape=(8, 8), seq_parallel="ring",
+        )
+        x = jnp.zeros((1, 16, 16, 3))
+        m.init({"params": jax.random.PRNGKey(0)}, x, is_training=False)
+
+
+def test_sp_rejects_attention_free_models(devices):
+    with pytest.raises(ValueError, match="sequence parallelism"):
+        create_model(
+            "mixer_s_patch32", num_classes=10, seq_parallel="ring",
+            seq_mesh=create_mesh({"data": 4, "seq": 2}),
+        )
+
+
+@pytest.mark.slow
+def test_trainer_sp_train_step(devices):
+    """TrainConfig.sequence_parallel drives a full train step on a
+    (data × seq) mesh — the framework-level capability, not the bare op."""
+    config = TrainConfig(
+        model_name="vit_ti_patch16",
+        num_classes=10,
+        image_size=32,
+        compute_dtype="float32",
+        global_batch_size=8,
+        num_train_images=32,
+        num_epochs=2,
+        warmup_epochs=1,
+        base_lr=1e-3,
+        transpose_images=False,
+        mesh_axes={"data": 4, "seq": 2},
+        sequence_parallel="ring",
+        model_overrides=dict(num_layers=2, embed_dim=64, num_heads=4),
+        seed=0,
+    )
+    trainer = Trainer(config)
+    assert trainer.model.seq_parallel == "ring"
+    batch = {
+        "images": np.random.default_rng(0)
+        .normal(size=(8, 32, 32, 3))
+        .astype(np.float32),
+        "labels": (np.arange(8) % 10).astype(np.int32),
+    }
+    state = trainer.init_state(0)
+    state, metrics = trainer.train_step(state, batch, jax.random.PRNGKey(0))
+    assert np.isfinite(float(jax.device_get(metrics["loss"])))
+    em = trainer.eval_step(state, batch)
+    assert np.isfinite(float(jax.device_get(em["loss_sum"])))
+
+
+def test_trainer_sp_requires_seq_axis(devices):
+    config = TrainConfig(
+        model_name="vit_ti_patch16",
+        num_classes=10,
+        image_size=32,
+        global_batch_size=8,
+        num_train_images=32,
+        sequence_parallel="ring",
+        transpose_images=False,
+    )
+    with pytest.raises(ValueError, match="'seq' mesh axis"):
+        Trainer(config)

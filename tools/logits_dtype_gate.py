@@ -67,6 +67,9 @@ def run_variant(logits_dtype, steps, batch_size, eval_every,
     model = create_model(
         cfg.model_name, num_classes=10, patch_shape=(8, 8), backend="xla",
         dtype=jnp.bfloat16 if compute_dtype == "bfloat16" else jnp.float32,
+        # External models carry their own logits dtype — thread the gated
+        # variant's setting or the A/B would silently compare identical runs.
+        logits_dtype=logits_dtype,
     )
     tr = Trainer(cfg, model=model)
     state = tr.init_state(0)

@@ -63,6 +63,17 @@ import click
 @click.option("--tp", type=int, default=1, help="Tensor-parallel mesh axis size.")
 @click.option("--fsdp", type=int, default=1, help="FSDP mesh axis size (params sharded).")
 @click.option(
+    "--sp", type=int, default=1,
+    help="Sequence-parallel mesh axis size: every self-attention core "
+    "shards its sequence over a 'seq' axis (ring attention by default — "
+    "exact, CLS-odd lengths handled by pad-and-mask).",
+)
+@click.option(
+    "--sp-method", type=click.Choice(["ring", "ulysses"]), default="ring",
+    help="SP strategy: 'ring' streams K/V by ppermute (any head count); "
+    "'ulysses' uses two all-to-alls (needs heads % sp == 0).",
+)
+@click.option(
     "--preset", type=str, default=None,
     help="Named experiment preset (sav_tpu.train.presets); CLI flags override.",
 )
@@ -112,7 +123,8 @@ def main(
     ctx, data_dir, fake_data, model_name, num_classes, image_size, batch_size,
     num_epochs, warmup_epochs, learning_rate, weight_decay, label_smoothing,
     clip_grad, grad_accum, augmentation, patch_size, backend, logits_dtype,
-    remat, dtype, tp, fsdp, preset, checkpoint_dir, steps, num_train_images,
+    remat, dtype, tp, fsdp, sp, sp_method, preset, checkpoint_dir, steps,
+    num_train_images,
     num_eval_images, crop_min_area, train_flip, platform, fused_optimizer,
     device_preprocess, seed,
 ):
@@ -143,12 +155,14 @@ def main(
     from sav_tpu.data.pipeline import Split, load
 
     mesh_axes = None
-    if tp > 1 or fsdp > 1:
-        mesh_axes = {"data": n_devices // (tp * fsdp)}
+    if tp > 1 or fsdp > 1 or sp > 1:
+        mesh_axes = {"data": n_devices // (tp * fsdp * sp)}
         if fsdp > 1:
             mesh_axes["fsdp"] = fsdp
         if tp > 1:
             mesh_axes["model"] = tp
+        if sp > 1:
+            mesh_axes["seq"] = sp
 
     config = TrainConfig(
         model_name=model_name,
@@ -172,6 +186,7 @@ def main(
         fused_optimizer=fused_optimizer,
         device_preprocess=device_preprocess,
         mesh_axes=mesh_axes,
+        sequence_parallel=sp_method if sp > 1 else None,
         checkpoint_dir=checkpoint_dir,
         seed=seed,
         **(
@@ -211,6 +226,8 @@ def main(
             )
         if mesh_axes is not None:
             overrides["mesh_axes"] = mesh_axes
+        if sp > 1:
+            overrides["sequence_parallel"] = sp_method
         config = get_preset(preset, **overrides)
         if "remat" in explicit:
             # Merge into the preset's overrides rather than replacing them —
@@ -242,20 +259,32 @@ def main(
         click.echo(config.to_json())
 
     model = None
+    mesh = None
     if patch_size is not None:
         import jax.numpy as jnp
 
         from sav_tpu.models import create_model
 
+        if config.sequence_parallel:
+            # The external model's attention blocks shard_map over the same
+            # mesh the trainer pjits on — build it once, share both ways.
+            from sav_tpu.parallel import create_mesh
+
+            mesh = create_mesh(config.mesh_axes)
         model = create_model(
             config.model_name,
             num_classes=config.num_classes,
             dtype=jnp.bfloat16 if config.compute_dtype == "bfloat16" else jnp.float32,
             backend=config.attention_backend,
+            # Externally built models carry their own logits dtype — thread
+            # the config's here or --logits-dtype would silently not apply.
+            logits_dtype=config.attention_logits_dtype,
+            seq_parallel=config.sequence_parallel,
+            seq_mesh=mesh,
             patch_shape=(patch_size, patch_size),
             **(config.model_overrides or {}),
         )
-    trainer = Trainer(config, model=model)
+    trainer = Trainer(config, mesh=mesh, model=model)
     # Restore BEFORE building the train stream so the data iterator starts
     # at the restored step: deterministic per-epoch pipelines make resume
     # replay the uninterrupted run's batch schedule (the reference lost

@@ -44,6 +44,26 @@ from sav_tpu.parallel.mesh import PIPE_AXIS
 StageFn = Callable[[Any, jax.Array], jax.Array]
 
 
+def module_stage_fn(module, **apply_kwargs) -> StageFn:
+    """Adapt a Flax module into a pipeline stage function.
+
+    ``module`` is any shape-preserving block (the model-zoo case: a ViT
+    ``EncoderBlock`` — every stage then runs one or more transformer layers
+    on its ``[mb, L, C]`` activation slice). ``apply_kwargs`` are forwarded
+    to ``module.apply`` (e.g. ``is_training=False``; pipeline training with
+    dropout would need per-stage RNG plumbing — sow a need before wiring).
+
+    The per-stage parameter trees come from initializing ``module`` once
+    per stage (identical structure, different values), then
+    :func:`stack_stage_params`.
+    """
+
+    def stage_fn(params, x):
+        return module.apply({"params": params}, x, **apply_kwargs)
+
+    return stage_fn
+
+
 def stack_stage_params(param_trees: Sequence[Any]) -> Any:
     """Stack per-stage parameter pytrees along a new leading stage axis.
 

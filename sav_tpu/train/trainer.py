@@ -98,6 +98,33 @@ class Trainer:
                 **(config.model_overrides or {}),
             )
         )
+        if model is not None:
+            # These config fields are model *attributes* now; an external
+            # model carries its own. Silent divergence would train with
+            # different softmax numerics / without SP than the config
+            # says (the old process-global pinning DID apply them), so
+            # mismatches fail loudly.
+            def _canon(d):
+                return None if d is None else jnp.dtype(d).name
+
+            want = config.attention_logits_dtype
+            have = getattr(model, "logits_dtype", None)
+            if want is not None and _canon(have) != _canon(want):
+                raise ValueError(
+                    f"config.attention_logits_dtype={want!r} but the "
+                    f"externally built model has logits_dtype={have!r}; "
+                    "pass create_model(..., logits_dtype=...) to match, or "
+                    "leave the config field None"
+                )
+            if config.sequence_parallel is not None and (
+                getattr(model, "seq_parallel", None) != config.sequence_parallel
+            ):
+                raise ValueError(
+                    f"config.sequence_parallel={config.sequence_parallel!r} "
+                    "but the externally built model does not carry it; pass "
+                    "create_model(..., seq_parallel=..., seq_mesh=...) to "
+                    "match, or leave the config field None"
+                )
         self.schedule = warmup_cosine_schedule(
             config.learning_rate,
             steps_per_epoch=config.steps_per_epoch,
@@ -393,18 +420,33 @@ class Trainer:
     # ------------------------------------------------------------- data flow
 
     def shard_batch(self, batch: dict) -> dict:
-        """Place a host batch onto the mesh, batch dim over the data axis."""
+        """Place a host batch onto the mesh, batch dim over the data axis.
+
+        Single-process: a plain ``device_put``. Multi-process (SPMD over
+        hosts — the reference's implicit TPU-VM setup,
+        input_pipeline.py:102): each process passes its *per-host* shard
+        (the data pipeline already yields per-host batches) and the global
+        array is assembled process-locally — no host gathers any other
+        host's data.
+        """
 
         baxes = batch_axes(self.mesh)
+        multiprocess = jax.process_count() > 1
 
         def sharding_for(key, leaf):
             if key == "images" and self.config.transpose_images and leaf.ndim == 4:
                 return NamedSharding(self.mesh, P(None, None, None, baxes))
             return NamedSharding(self.mesh, P(baxes))
 
-        return {
-            k: jax.device_put(v, sharding_for(k, v)) for k, v in batch.items()
-        }
+        def place(key, leaf):
+            sharding = sharding_for(key, leaf)
+            if multiprocess:
+                return jax.make_array_from_process_local_data(
+                    sharding, np.asarray(leaf)
+                )
+            return jax.device_put(leaf, sharding)
+
+        return {k: place(k, v) for k, v in batch.items()}
 
     # ------------------------------------------------------------------ loop
 

@@ -145,6 +145,78 @@ def test_pipeline_composes_with_data_parallel(devices):
     )
 
 
+def _encoder_setup(num_stages=4, batch=16, length=17, dim=64, seed=10):
+    """Real model-zoo stages: one ViT EncoderBlock (pre-LN MHSA + FF) per
+    pipeline stage, per-stage params from independent inits (VERDICT r3
+    item 6 — the toy gelu stage proved the schedule, not the model)."""
+    from sav_tpu.models.vit import EncoderBlock
+    from sav_tpu.parallel.pipelining import module_stage_fn
+
+    block = EncoderBlock(num_heads=4, dtype=jnp.float32)
+    x = jax.random.normal(
+        jax.random.PRNGKey(seed), (batch, length, dim), jnp.float32
+    )
+    trees = [
+        block.init(
+            {"params": jax.random.fold_in(jax.random.PRNGKey(seed + 1), i)},
+            x[:1],
+            False,
+        )["params"]
+        for i in range(num_stages)
+    ]
+    stage_fn = module_stage_fn(block, is_training=False)
+    return stage_fn, trees, x
+
+
+@pytest.mark.slow
+def test_pipeline_encoder_blocks_match_sequential(devices):
+    num_stages = 4
+    mesh = create_mesh({"pipe": num_stages}, devices=devices[:num_stages])
+    stage_fn, trees, x = _encoder_setup(num_stages)
+    stacked = stack_stage_params(trees)
+
+    out = pipeline(stage_fn, stacked, x, mesh=mesh, num_microbatches=4)
+    ref = x
+    for p in trees:
+        ref = stage_fn(p, ref)
+    np.testing.assert_allclose(
+        np.asarray(out), np.asarray(ref), rtol=2e-5, atol=2e-5
+    )
+
+
+@pytest.mark.slow
+def test_pipeline_encoder_blocks_grads_match_sequential(devices):
+    """End-to-end differentiation through pipelined transformer stages —
+    loss AND parameter grads (every stage's attention/FF kernels) against
+    the unpipelined stack."""
+    num_stages = 4
+    mesh = create_mesh({"pipe": num_stages}, devices=devices[:num_stages])
+    stage_fn, trees, x = _encoder_setup(num_stages, batch=8)
+    stacked = stack_stage_params(trees)
+
+    def loss_pipe(stacked, x):
+        return jnp.mean(
+            pipeline(stage_fn, stacked, x, mesh=mesh, num_microbatches=4) ** 2
+        )
+
+    def loss_seq(stacked, x):
+        h = x
+        for i in range(num_stages):
+            h = stage_fn(jax.tree.map(lambda p: p[i], stacked), h)
+        return jnp.mean(h**2)
+
+    lp, g_pipe = jax.value_and_grad(loss_pipe)(stacked, x)
+    ls, g_seq = jax.value_and_grad(loss_seq)(stacked, x)
+    np.testing.assert_allclose(float(lp), float(ls), rtol=1e-5)
+    jax.tree.map(
+        lambda a, b: np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=2e-4, atol=1e-5
+        ),
+        g_pipe,
+        g_seq,
+    )
+
+
 def test_pipeline_rejects_stage_mesh_mismatch(devices):
     mesh = create_mesh({"pipe": 2}, devices=devices[:2])
     trees = _make_stage_params(jax.random.PRNGKey(8), 4, 8)

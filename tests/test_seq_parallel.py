@@ -80,7 +80,6 @@ def test_sp_vit_forward_matches_unsharded(devices, method):
     x = jax.random.normal(jax.random.PRNGKey(0), (4, 32, 32, 3), jnp.float32)
     variables = dense.init({"params": jax.random.PRNGKey(1)}, x, is_training=False)
     # Zero-init head makes fresh logits vacuously equal — randomize it.
-    variables = jax.tree.map(lambda a: a, variables)  # unfreeze-safe copy
     head = variables["params"]["head"]["kernel"]
     variables["params"]["head"]["kernel"] = jax.random.normal(
         jax.random.PRNGKey(2), head.shape, head.dtype

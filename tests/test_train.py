@@ -413,6 +413,29 @@ def test_logits_dtype_ignores_process_global(devices):
     np.testing.assert_array_equal(poisoned, clean)
 
 
+def test_logits_dtype_external_model_mismatch_raises(devices):
+    """An external model carries its own logits_dtype; a config that says
+    otherwise must fail loudly (the old process-global pinning DID apply
+    the config to external models — silence would be a regression)."""
+    from sav_tpu.models import create_model
+
+    cfg = _smoke_config(
+        compute_dtype="bfloat16", attention_logits_dtype="float32"
+    )
+    model = create_model(
+        cfg.model_name, num_classes=10, dtype=jnp.bfloat16,
+        **_small_model_overrides(),
+    )
+    with pytest.raises(ValueError, match="attention_logits_dtype"):
+        Trainer(cfg, model=model)
+    # Matching attribute: accepted.
+    ok = create_model(
+        cfg.model_name, num_classes=10, dtype=jnp.bfloat16,
+        logits_dtype="float32", **_small_model_overrides(),
+    )
+    Trainer(cfg, model=ok)
+
+
 def test_logits_dtype_inherits_compute_dtype(devices):
     """attention_logits_dtype=None resolves to the compute dtype — the
     reference's semantics (its logits einsum runs in the model dtype), so

@@ -1,0 +1,39 @@
+"""Multi-process distributed bring-up smoke (VERDICT r3 item 8).
+
+Wraps ``tools/two_process_smoke.py``: two OS processes, one
+``jax.distributed.initialize`` rendezvous, one global DP mesh, six train
+steps — the parent asserts both ranks' losses agree bit-for-bit and
+decrease. Skips (rather than fails) when the sandbox forbids the local
+TCP rendezvous the coordinator needs.
+"""
+
+import os
+import subprocess
+import sys
+
+import pytest
+
+
+@pytest.mark.slow
+def test_two_process_dp_smoke():
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    proc = subprocess.run(
+        [sys.executable, os.path.join(repo, "tools", "two_process_smoke.py")],
+        capture_output=True,
+        text=True,
+        timeout=900,
+    )
+    out = proc.stdout + proc.stderr
+    # Skip ONLY on rendezvous-setup failures (sandbox forbids the local TCP
+    # coordinator) — narrow patterns so a genuine mid-run distributed
+    # regression (which also surfaces barrier/UNAVAILABLE text) still FAILS.
+    setup_errors = (
+        "Address already in use",
+        "Permission denied",
+        "Failed to connect to coordinator",
+        "Cannot assign requested address",
+    )
+    if proc.returncode != 0 and any(e in out for e in setup_errors):
+        pytest.skip(f"multi-process rendezvous unsupported here: {out[-400:]}")
+    assert proc.returncode == 0, out[-2000:]
+    assert "AGREE" in out

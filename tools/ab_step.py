@@ -9,6 +9,16 @@ Variants (any comma list via --variants):
   bf16logits — TrainConfig.attention_logits_dtype='bfloat16' (halved L²
                softmax HBM traffic)
   nofuse     — fused_optimizer=False
+  nomax      — non-stabilized softmax (skip the running-max subtraction):
+               one fewer full pass over the [B,H,L,L] tensor. MEASUREMENT
+               ONLY — exp overflows past logits ~88, so shipping it would
+               need an accuracy gate + magnitude argument.
+  bhld       — attention core in [B,H,L,D] layout (transpose after the
+               projections, batched matmuls, transpose back) — tests
+               whether the '...qhd,...khd->...hqk' einsums' implicit
+               relayouts beat explicit one-shot transposes.
+  noclip     — clip_grad_norm=None: prices the global-norm pass in the
+               'optimizer + rest' bucket (PERF.md §5's trace: ~8 ms).
 
 Prints one line per variant: best/median step ms over N windows. Chip
 throughput drifts minute-to-minute (~2x, PERF.md §5) — re-run and compare
@@ -62,7 +72,10 @@ def main():
     from sav_tpu.train import TrainConfig, Trainer
     from sav_tpu.ops import attention as att
 
-    known = {"base", "fastvjp", "bf16logits", "nofuse"}
+    import jax.numpy as jnp
+
+    known = {"base", "fastvjp", "bf16logits", "nofuse", "nomax", "bhld",
+             "noclip"}
     variants = args.variants.split(",")
     unknown = set(variants) - known
     if unknown:
@@ -71,8 +84,10 @@ def main():
     batch = make_batch(args.batch_size, 224)
 
     orig_xla = att.xla_attention
+    orig_softmax = att._softmax_probs
     for variant in variants:
         att.xla_attention = orig_xla
+        att._softmax_probs = orig_softmax
         if variant == "fastvjp":
 
             def _fastvjp(q, k, v, bias=None, *, scale=None, dropout_rate=0.0,
@@ -87,23 +102,64 @@ def main():
                 return att.xla_attention_fast(q, k, v, bias, scale=scale)
 
             att.xla_attention = _fastvjp
+        elif variant == "nomax":
+
+            def _nomax_probs(q, k, bias, scale, logits_dtype):
+                qs = q * jnp.asarray(scale, dtype=q.dtype)
+                logits = jnp.einsum(
+                    "...qhd,...khd->...hqk", qs, k,
+                    preferred_element_type=jnp.dtype(logits_dtype),
+                )
+                if bias is not None:
+                    logits = logits + bias.astype(logits.dtype)
+                e = jnp.exp(logits)
+                return e / jnp.sum(e, axis=-1, keepdims=True)
+
+            att._softmax_probs = _nomax_probs
+        elif variant == "bhld":
+
+            def _bhld(q, k, v, bias=None, *, scale=None, dropout_rate=0.0,
+                      dropout_rng=None, deterministic=True, logits_dtype=None,
+                      **kw):
+                if dropout_rate > 0.0 and not deterministic:
+                    raise ValueError("bhld A/B variant is deterministic-only")
+                if scale is None:
+                    scale = q.shape[-1] ** -0.5
+                ld = jnp.dtype(logits_dtype) if logits_dtype else jnp.float32
+                qt = jnp.transpose(q * jnp.asarray(scale, q.dtype), (0, 2, 1, 3))
+                kt = jnp.transpose(k, (0, 2, 1, 3))
+                vt = jnp.transpose(v, (0, 2, 1, 3))
+                s = jnp.einsum(
+                    "bhqd,bhkd->bhqk", qt, kt, preferred_element_type=ld
+                )
+                if bias is not None:
+                    s = s + bias.astype(s.dtype)
+                p = jax.nn.softmax(s, axis=-1).astype(vt.dtype)
+                o = jnp.einsum("bhqk,bhkd->bhqd", p, vt)
+                return jnp.transpose(o, (0, 2, 1, 3))
+
+            att.xla_attention = _bhld
         config = TrainConfig(
             model_name=args.model,
             num_classes=1000,
             image_size=224,
             compute_dtype="bfloat16",
             attention_backend="xla",
-            # Trainer resets the process logits-dtype default from this
-            # field on construction — set it here, not via the module API.
-            # 'float32' explicitly: None now inherits the compute dtype
-            # (bf16 here), which would collapse base and bf16logits into
-            # the same configuration.
+            # 'float32' explicitly for base/fastvjp/nofuse: None inherits
+            # the compute dtype (bf16), which would collapse base and
+            # bf16logits into the same configuration. The round-4 variants
+            # (nomax/bhld/noclip) ride bf16 logits so their deltas read
+            # against the SHIPPING config — compare them to the bf16logits
+            # row, not base. Threads through create_model into the blocks'
+            # logits_dtype attribute.
             attention_logits_dtype=(
-                "bfloat16" if variant == "bf16logits" else "float32"
+                "bfloat16"
+                if variant in ("bf16logits", "nomax", "bhld", "noclip")
+                else "float32"
             ),
             global_batch_size=args.batch_size,
             transpose_images=False,
-            clip_grad_norm=1.0,
+            clip_grad_norm=None if variant == "noclip" else 1.0,
             fused_optimizer=False if variant == "nofuse" else None,
             seed=0,
         )
@@ -111,6 +167,7 @@ def main():
         best, med = time_steps(trainer, batch)
         print(f"{variant:10s} best {best:7.2f} ms  median {med:7.2f} ms", flush=True)
     att.xla_attention = orig_xla
+    att._softmax_probs = orig_softmax
 
 
 if __name__ == "__main__":

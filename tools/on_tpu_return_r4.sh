@@ -1,0 +1,81 @@
+#!/bin/bash
+# Round-4 relay-return battery: poll the TPU relay; when it answers, run the
+# queued on-chip validations in priority order. Replaces the r3b battery
+# (same probe/run pattern) — kill the old poller before launching this one.
+# Outputs land in .tpu_results/; commit the interesting ones to evidence/.
+#
+# Priorities (VERDICT r3 "Next round"):
+#   1. zoo compiler sweep for the 5 never-on-chip families (item 2)
+#   2. per-family digits training runs through the real CLI (item 4)
+#   3. fed benches + headline bench (item 1)
+set -u
+cd /root/repo
+mkdir -p .tpu_results
+LOG=.tpu_results/r4_log
+
+probe() {
+  timeout 90 python -u -c "
+import jax, jax.numpy as jnp
+assert jax.devices()[0].platform != 'cpu', jax.devices()
+print(jax.device_get((jnp.ones((256,256),jnp.bfloat16)@jnp.ones((256,256),jnp.bfloat16)).sum()))
+" >/dev/null 2>&1
+}
+
+echo "$(date) polling for TPU relay" > "$LOG"
+until probe; do
+  sleep 180
+done
+echo "$(date) TPU is back — running r4 battery" >> "$LOG"
+
+run() {  # run <name> <timeout_s> <cmd...>
+  local name=$1 t=$2; shift 2
+  echo "$(date) START $name" >> "$LOG"
+  timeout "$t" "$@" > ".tpu_results/$name.out" 2>&1
+  local rc=$?
+  echo "$(date) DONE $name (rc=$rc)" >> "$LOG"
+}
+
+# --- 1. Zoo compiler sweep: the never-on-chip families, both backends -------
+run zoo_ceit   5400 env PYTHONPATH=/root/repo:/root/.axon_site python tools/zoo_tpu_check.py --only ceit
+run zoo_tnt    5400 env PYTHONPATH=/root/repo:/root/.axon_site python tools/zoo_tpu_check.py --only tnt
+run zoo_botnet 5400 env PYTHONPATH=/root/repo:/root/.axon_site python tools/zoo_tpu_check.py --only botnet
+run zoo_mixer  2700 env PYTHONPATH=/root/repo:/root/.axon_site python tools/zoo_tpu_check.py --only mixer
+
+# cvt: known-pathological XLA-TPU compile pre-depthwise-fix; generous budget,
+# reduced size for signal.
+run cvt_probe 5400 env PYTHONPATH=/root/repo:/root/.axon_site python - <<'EOF'
+import time, jax, jax.numpy as jnp
+from sav_tpu.models import create_model
+t0 = time.time()
+x = jax.random.normal(jax.random.PRNGKey(0), (2, 96, 96, 3), jnp.bfloat16)
+model = create_model("cvt-13", num_classes=10, dtype=jnp.bfloat16)
+v = model.init({"params": jax.random.PRNGKey(0)}, x, is_training=False)
+out = jax.jit(lambda v, x: model.apply(v, x, is_training=False))(v, x)
+print(float(jax.device_get(out.astype(jnp.float32)).sum()))
+print(f"cvt-13 fwd @96^2 compile+run: {time.time()-t0:.0f}s")
+EOF
+
+# --- 2. Per-family digits training runs (real CLI, real TPU) ----------------
+if [ ! -d .data/digits ]; then
+  run make_digits 900 python tools/make_digits_tfrecords.py --out .data/digits
+fi
+for fam in cait cvt botnet tnt ceit mixer; do
+  run "train_${fam}" 5400 python train.py \
+    --preset "${fam}_digits" --data-dir .data/digits \
+    --num-train-images 1438 --num-eval-images 359 \
+    --crop-min-area 0.5 --no-train-flip \
+    -c ".ckpt/${fam}_digits" --seed 42
+done
+
+# --- 3. MFU attribution: round-4 A/B variants + a fresh trace ---------------
+# Control row is bf16logits (the shipping config); nomax/bhld/noclip ride it.
+run ab_r4 3000 env PYTHONPATH=/root/repo:/root/.axon_site python tools/ab_step.py \
+  --variants bf16logits,nomax,bhld,noclip
+run profile_r4 1800 env PYTHONPATH=/root/repo:/root/.axon_site python tools/profile_step.py
+
+# --- 4. Benches -------------------------------------------------------------
+run bench_savrec_host  1500 python bench.py --feed savrec --steps 6
+run bench_savrec_devpp 1500 python bench.py --feed savrec --steps 6 --device-preprocess
+run bench_final        1800 python bench.py
+
+echo "$(date) r4 battery complete" >> "$LOG"

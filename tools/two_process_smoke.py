@@ -1,0 +1,182 @@
+#!/usr/bin/env python
+"""Two-process distributed smoke: multi-process init → mesh → DP train step.
+
+VERDICT r3 item 8: nothing had ever *executed* the multi-process bring-up
+path (``distributed_init`` → ``jax.distributed.initialize`` → one global
+mesh spanning two processes' devices), even on CPU. This script is that
+evidence — the CPU stand-in for the reference's implicit multi-host TPU-VM
+SPMD (input_pipeline.py:102, train.py:96):
+
+- the parent spawns 2 worker processes (rank 0 hosts the coordinator);
+- each worker runs ``jax.distributed.initialize(coordinator, 2, rank)``
+  via :func:`sav_tpu.parallel.distributed_init`, sees 4 global devices
+  (2 local CPU devices each), builds one ``data=4`` mesh across both
+  processes, and runs ONE DP train step through the real ``Trainer``
+  (``shard_batch`` assembles the global batch from per-host shards via
+  ``jax.make_array_from_process_local_data``);
+- both workers print their loss; the parent asserts the two agree
+  bit-for-bit (the gradient AllReduce crossed the process boundary) and
+  that a second step decreases the loss.
+
+Run: ``python tools/two_process_smoke.py`` (CPU; ~1-2 min on one core).
+Committed output: evidence/two_process_smoke.txt.
+"""
+
+from __future__ import annotations
+
+import os
+import socket
+import subprocess
+import sys
+
+GLOBAL_BATCH = 8
+N_LOCAL_DEVICES = 2
+NUM_PROCESSES = 2
+
+
+def worker(rank: int, coordinator: str) -> None:
+    from sav_tpu.parallel import create_mesh, distributed_init
+
+    distributed_init(coordinator, NUM_PROCESSES, rank)
+
+    import jax
+    import numpy as np
+
+    assert jax.process_count() == NUM_PROCESSES, jax.process_count()
+    n_global = NUM_PROCESSES * N_LOCAL_DEVICES
+    assert len(jax.devices()) == n_global, jax.devices()
+
+    from sav_tpu.train import TrainConfig, Trainer
+
+    config = TrainConfig(
+        model_name="vit_ti_patch16",
+        num_classes=10,
+        image_size=32,
+        compute_dtype="float32",
+        global_batch_size=GLOBAL_BATCH,
+        num_train_images=GLOBAL_BATCH * 4,
+        num_epochs=2,
+        warmup_epochs=1,
+        base_lr=0.05,  # LR auto-scales by batch/512; keep the step visible
+        transpose_images=False,
+        model_overrides=dict(num_layers=2, embed_dim=64, num_heads=4),
+        seed=0,
+    )
+    trainer = Trainer(config)
+    mesh = trainer.mesh
+    assert mesh.devices.size == n_global, mesh
+
+    # Per-host batch shard: every process derives the SAME global batch from
+    # the seed, then keeps its half — exactly the data pipeline's per-host
+    # sharding contract (sav_tpu/data/pipeline.py process_index/count).
+    rng = np.random.default_rng(0)
+    labels = rng.integers(0, 10, (GLOBAL_BATCH,))
+    images = (
+        labels[:, None, None, None] * 20 + rng.normal(0, 8, (GLOBAL_BATCH, 32, 32, 3))
+    ).astype(np.float32) / 127.5 - 1.0
+    per_host = GLOBAL_BATCH // NUM_PROCESSES
+    sl = slice(rank * per_host, (rank + 1) * per_host)
+    batch = {"images": images[sl], "labels": labels[sl].astype(np.int32)}
+
+    state = trainer.init_state(0)
+    losses = []
+    # Several steps: warmup LR is 0 at step 0 (nothing moves), so proving
+    # the cross-process update path needs the ramp to kick in.
+    for i in range(6):
+        state, metrics = trainer.train_step(state, batch, jax.random.PRNGKey(i))
+        losses.append(float(jax.device_get(metrics["loss"])))
+    print("RANK %d LOSS %s" % (rank, " ".join(f"{l:.9f}" for l in losses)), flush=True)
+    jax.distributed.shutdown()
+
+
+def main() -> int:
+    if "--rank" in sys.argv:
+        rank = int(sys.argv[sys.argv.index("--rank") + 1])
+        worker(rank, os.environ["SMOKE_COORDINATOR"])
+        return 0
+    # bind-then-close port picking races other processes on the host; one
+    # retry with a fresh port covers the TOCTOU without masking real bugs
+    # (only rendezvous-setup errors trigger it).
+    rc = _run_once()
+    if rc == 2:
+        print("retrying once with a fresh coordinator port", flush=True)
+        rc = _run_once()
+    return rc
+
+
+def _run_once() -> int:
+    with socket.socket() as s:  # pick a free coordinator port
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+
+    env = dict(os.environ)
+    repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env["PYTHONPATH"] = repo_root + (
+        os.pathsep + env["PYTHONPATH"] if env.get("PYTHONPATH") else ""
+    )
+    # Clean CPU JAX in the workers: the axon relay plugin (gated on
+    # PALLAS_AXON_POOL_IPS) hangs backend init while the relay is down and
+    # overrides JAX_PLATFORMS either way.
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = (
+        env.get("XLA_FLAGS", "")
+        + f" --xla_force_host_platform_device_count={N_LOCAL_DEVICES}"
+    )
+    env["SMOKE_COORDINATOR"] = f"127.0.0.1:{port}"
+
+    procs = [
+        subprocess.Popen(
+            [sys.executable, __file__, "--rank", str(r)],
+            env=env,
+            stdout=subprocess.PIPE,
+            stderr=subprocess.STDOUT,
+            text=True,
+        )
+        for r in range(NUM_PROCESSES)
+    ]
+    outs = []
+    ok = True
+    for r, p in enumerate(procs):
+        try:
+            out, _ = p.communicate(timeout=600)
+        except subprocess.TimeoutExpired:
+            p.kill()
+            out, _ = p.communicate()
+            ok = False
+        outs.append(out)
+        print(f"--- rank {r} (rc={p.returncode}) ---\n{out}")
+        ok = ok and p.returncode == 0
+
+    losses = {}
+    for out in outs:
+        for line in out.splitlines():
+            if line.startswith("RANK"):
+                parts = line.split()
+                losses[int(parts[1])] = tuple(float(x) for x in parts[3:])
+    if not ok or len(losses) != NUM_PROCESSES:
+        all_out = "\n".join(outs)
+        if "Address already in use" in all_out or (
+            "Failed to connect to coordinator" in all_out
+        ):
+            print("FAIL: coordinator port rendezvous failed (port race)")
+            return 2
+        print("FAIL: workers did not complete")
+        return 1
+    if losses[0] != losses[1]:
+        print(f"FAIL: processes disagree on the loss: {losses}")
+        return 1
+    seq = losses[0]
+    if not (seq[-1] < seq[0]):
+        print(f"FAIL: loss did not decrease over the DP steps: {seq}")
+        return 1
+    print(
+        f"AGREE: both processes computed losses {seq[0]:.9f} -> {seq[-1]:.9f} "
+        f"bit-for-bit (one {NUM_PROCESSES}-process data-parallel mesh, "
+        "gradient AllReduce across the process boundary)"
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -11,6 +11,10 @@ import pytest
 from sav_tpu.ops import preprocess as pp
 
 
+
+# Entire module is the expensive tier: mesh/kernel-heavy numerics sweeps.
+pytestmark = pytest.mark.slow
+
 def _uint8_images(n=8, size=32, seed=0):
     rng = np.random.default_rng(seed)
     images = rng.integers(0, 256, (n, size, size, 3), dtype=np.uint8)

@@ -11,6 +11,8 @@ from sav_tpu.ops.attention import dot_product_attention, xla_attention_fast
 from sav_tpu.ops.relative import rel_to_abs
 
 
+
+
 def _qkv(b=2, lq=197, lk=None, h=4, d=64, dtype=jnp.float32, seed=0):
     lk = lk or lq
     ks = jax.random.split(jax.random.PRNGKey(seed), 3)
@@ -31,6 +33,7 @@ def _qkv(b=2, lq=197, lk=None, h=4, d=64, dtype=jnp.float32, seed=0):
         (785, 785, 40),  # TNT-B outer-ish, odd head dim
     ],
 )
+@pytest.mark.slow
 def test_flash_matches_xla(lq, lk, d):
     q, k, v = _qkv(lq=lq, lk=lk, d=d)
     ref = xla_attention(q, k, v)
@@ -38,6 +41,7 @@ def test_flash_matches_xla(lq, lk, d):
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5, rtol=2e-5)
 
 
+@pytest.mark.slow
 def test_flash_with_bias_matches_xla():
     q, k, v = _qkv(lq=64, lk=64, d=32)
     bias = jax.random.normal(jax.random.PRNGKey(9), (2, 4, 64, 64))
@@ -46,6 +50,7 @@ def test_flash_with_bias_matches_xla():
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5, rtol=2e-5)
 
 
+@pytest.mark.slow
 def test_flash_with_shared_bias():
     q, k, v = _qkv(lq=33, lk=33, d=16)
     bias = jax.random.normal(jax.random.PRNGKey(9), (1, 1, 33, 33))
@@ -54,6 +59,7 @@ def test_flash_with_shared_bias():
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5, rtol=2e-5)
 
 
+@pytest.mark.slow
 def test_flash_gradients_match_xla():
     q, k, v = _qkv(lq=50, lk=50, d=32)
     bias = jax.random.normal(jax.random.PRNGKey(9), (1, 4, 50, 50))
@@ -81,6 +87,7 @@ def test_flash_gradients_match_xla():
         (320, 256, 40, 128),  # multi-block q and kv, odd head dim
     ],
 )
+@pytest.mark.slow
 def test_flash_blocked_backward_matches_xla(lq, lk, d, blk):
     """No-bias gradients run the blocked Pallas backward kernels."""
     q, k, v = _qkv(lq=lq, lk=lk, d=d)
@@ -98,6 +105,7 @@ def test_flash_blocked_backward_matches_xla(lq, lk, d, blk):
         )
 
 
+@pytest.mark.slow
 def test_flash_blocked_backward_bf16_finite_and_close():
     q, k, v = _qkv(lq=197, lk=197, d=64, dtype=jnp.bfloat16)
 
@@ -113,6 +121,7 @@ def test_flash_blocked_backward_bf16_finite_and_close():
         np.testing.assert_allclose(a, b, atol=0.15, rtol=0.15)
 
 
+@pytest.mark.slow
 def test_flash_bf16():
     q, k, v = _qkv(lq=197, lk=197, d=64, dtype=jnp.bfloat16)
     ref = xla_attention(q, k, v)
@@ -123,6 +132,7 @@ def test_flash_bf16():
     )
 
 
+@pytest.mark.slow
 def test_flash_softmax_stability():
     """Large logit magnitudes must not overflow the online softmax."""
     q, k, v = _qkv(lq=64, lk=64, d=32)
@@ -130,6 +140,7 @@ def test_flash_softmax_stability():
     assert np.isfinite(np.asarray(out)).all()
 
 
+@pytest.mark.slow
 def test_dispatch_backends_agree():
     q, k, v = _qkv(lq=60, lk=60, d=16)
     out_x = dot_product_attention(q, k, v, backend="xla")
@@ -177,6 +188,7 @@ def test_relative_logits_2d_offsets():
 
 
 @pytest.mark.parametrize("lq,lk,h,d", [(196, 196, 4, 48), (50, 50, 2, 32)])
+@pytest.mark.slow
 def test_talking_heads_fused_matches_xla(lq, lk, h, d):
     from sav_tpu.ops.talking_heads import (
         _th_dense_reference,
@@ -192,6 +204,7 @@ def test_talking_heads_fused_matches_xla(lq, lk, h, d):
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=5e-5, rtol=5e-5)
 
 
+@pytest.mark.slow
 def test_talking_heads_fused_gradients_match_dense():
     from sav_tpu.ops.talking_heads import (
         _th_dense_reference,
@@ -216,6 +229,7 @@ def test_talking_heads_fused_gradients_match_dense():
         np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=5e-5, rtol=5e-4)
 
 
+@pytest.mark.slow
 def test_talking_heads_blocked_backward_multi_qblock():
     """block_q < q_len drives the backward's dk/dv/dW accumulation across
     sequential q-block grid cells (and the zero-padded final block)."""
@@ -286,6 +300,7 @@ def test_talking_heads_block_kernel_accessor():
         (50, 50, 32, True),  # bias gradient path
     ],
 )
+@pytest.mark.slow
 def test_fast_vjp_matches_autodiff_f32(lq, lk, d, with_bias):
     """xla_attention_fast: hand-written VJP vs autodiff of the reference
     path. In f32 the residual-storage dtype matches, so gradients agree to
@@ -317,6 +332,7 @@ def test_fast_vjp_matches_autodiff_f32(lq, lk, d, with_bias):
         )
 
 
+@pytest.mark.slow
 def test_fast_vjp_bf16_close_to_f32_chain():
     """bf16 inputs: fast-VJP gradients stay within bf16 quantization of the
     all-f32 gradient chain (the correctness bound claimed in the docstring)."""
@@ -342,6 +358,7 @@ def test_fast_vjp_bf16_close_to_f32_chain():
         assert np.median(np.abs(a - b) / denom) < 2e-2
 
 
+@pytest.mark.slow
 def test_dot_product_attention_xla_matches_reference():
     """Dispatcher's XLA branch runs the plain-autodiff reference path
     (measured faster than the hand VJP on v5e — PERF.md §5); the fast path
@@ -375,6 +392,7 @@ def test_logits_dtype_default_knob():
     np.testing.assert_allclose(hi, ref, atol=2e-5, rtol=2e-5)
 
 
+@pytest.mark.slow
 def test_fast_vjp_bf16_bias_cotangent_dtype():
     """bf16 bias (the BoTNet training configuration): dbias must come back
     in the primal dtype or custom_vjp rejects the cotangent at trace time."""
@@ -392,6 +410,7 @@ def test_fast_vjp_bf16_bias_cotangent_dtype():
 
 
 @pytest.mark.parametrize("bias_shape", [(4, 24, 24), (24, 24), (1, 24)])
+@pytest.mark.slow
 def test_fast_vjp_low_rank_bias_matches_autodiff(bias_shape):
     """Bias with rank < logits rank broadcasts from the right; the hand
     VJP must reduce accordingly (left-aligned pairing is wrong/crashes)."""

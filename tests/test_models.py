@@ -17,6 +17,8 @@ import pytest
 from sav_tpu import models
 
 
+
+
 def _rngs():
     return {
         "params": jax.random.PRNGKey(0),
@@ -39,6 +41,7 @@ def _run(model, image_size=32, channels=3, batch=2, is_training=True):
     return logits, variables
 
 
+@pytest.mark.slow
 def test_vit():
     model = models.ViT(
         num_classes=10, embed_dim=64, num_layers=2, num_heads=4, patch_shape=(8, 8)
@@ -47,6 +50,7 @@ def test_vit():
     chex.assert_shape(logits, (2, 10))
 
 
+@pytest.mark.slow
 def test_mixer():
     model = models.MLPMixer(
         num_classes=10, embed_dim=64, num_layers=2, tokens_hidden_ch=32,
@@ -56,6 +60,7 @@ def test_mixer():
     chex.assert_shape(logits, (2, 10))
 
 
+@pytest.mark.slow
 def test_cait():
     model = models.CaiT(
         num_classes=10, embed_dim=64, num_layers=2, num_layers_token_only=2,
@@ -65,6 +70,7 @@ def test_cait():
     chex.assert_shape(logits, (2, 10))
 
 
+@pytest.mark.slow
 def test_tnt():
     model = models.TNT(
         num_classes=10, embed_dim=64, inner_ch=24, num_layers=2, num_heads=4,
@@ -74,6 +80,7 @@ def test_tnt():
     chex.assert_shape(logits, (2, 10))
 
 
+@pytest.mark.slow
 def test_ceit():
     model = models.CeiT(
         num_classes=10, embed_dim=64, num_layers=2, num_heads=4, patch_shape=(4, 4)
@@ -83,6 +90,7 @@ def test_ceit():
     assert "batch_stats" in variables  # LeFF + stem BatchNorm
 
 
+@pytest.mark.slow
 def test_cvt():
     model = models.CvT(
         num_classes=10, embed_dims=(32, 64, 128), num_layers=(1, 1, 2),
@@ -93,6 +101,7 @@ def test_cvt():
     assert "batch_stats" in variables  # conv projection BatchNorm
 
 
+@pytest.mark.slow
 def test_botnet():
     model = models.BoTNet(num_classes=10, stage_sizes=(1, 1, 1, 1))
     logits, variables = _run(model, image_size=64)
@@ -100,6 +109,7 @@ def test_botnet():
     assert "batch_stats" in variables
 
 
+@pytest.mark.slow
 def test_botnet_eval_mode():
     model = models.BoTNet(num_classes=10, stage_sizes=(1, 1, 1, 1))
     x = jnp.ones((2, 64, 64, 3), jnp.float32)
@@ -132,6 +142,7 @@ def test_registry_unknown_name():
         models.create_model("nope")
 
 
+@pytest.mark.slow
 def test_bf16_dtype():
     model = models.create_model(
         "vit_ti_patch16", num_classes=10, dtype=jnp.bfloat16
@@ -196,6 +207,7 @@ def _small_config(kind):
 
 
 @pytest.mark.parametrize("kind", ["vit", "cait", "tnt", "ceit", "cvt", "botnet"])
+@pytest.mark.slow
 def test_model_pallas_backend_matches_xla(kind):
     """Every attention model family cross-checks Pallas vs XLA logits
     (BASELINE.json north-star test requirement; CaiT via the fused
@@ -217,6 +229,7 @@ def test_model_pallas_backend_matches_xla(kind):
     np.testing.assert_allclose(outs["pallas"], outs["xla"], atol=1e-4, rtol=5e-3)
 
 
+@pytest.mark.slow
 def test_cait_pallas_backward_runs_and_matches():
     import numpy as np
 
@@ -244,6 +257,7 @@ def test_cait_pallas_backward_runs_and_matches():
         )
 
 
+@pytest.mark.slow
 def test_vit_remat_matches_no_remat():
     """remat=True must be numerically identical fwd and bwd (it only changes
     what the backward rematerializes) while keeping the same param tree."""

@@ -59,6 +59,7 @@ def test_moe_rejects_bad_top_k():
         block.init({"params": jax.random.PRNGKey(0)}, x, is_training=False)
 
 
+@pytest.mark.slow
 def test_moe_vit_model_forward():
     model = create_model("vit_moe_s_patch16_e8", num_classes=10, num_layers=2,
                          embed_dim=64, num_heads=4)
@@ -72,6 +73,7 @@ def test_moe_vit_model_forward():
     assert "MoEFFBlock_0" not in enc["block_0"]
 
 
+@pytest.mark.slow
 def test_moe_expert_parallel_sharding(devices):
     """Expert weights shard over the 'expert' axis; grads stay finite."""
     mesh = create_mesh({"data": 2, "expert": 4})
@@ -107,6 +109,7 @@ def test_moe_expert_parallel_sharding(devices):
     )
 
 
+@pytest.mark.slow
 def test_moe_trainer_step_includes_aux_loss(devices):
     """Full train step on an expert-parallel mesh: aux loss in metrics."""
     from sav_tpu.data import synthetic_data_iterator

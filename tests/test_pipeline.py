@@ -11,6 +11,8 @@ from sav_tpu.data import Split, load, parse_augment_spec
 from sav_tpu.data.augment_spec import AugmentSpec
 
 
+
+
 def _images(n=16, size=64, seed=0):
     rng = np.random.default_rng(seed)
     images = rng.integers(0, 256, (n, size, size, 3), dtype=np.uint8)
@@ -48,6 +50,7 @@ def test_parse_autoaugment_and_none():
 # -------------------------------------------------------------- image ops
 
 
+@pytest.mark.slow
 def test_image_ops_preserve_shape_dtype():
     from sav_tpu.data import image_ops as ops
 
@@ -87,6 +90,7 @@ def test_identity_magnitudes():
     np.testing.assert_array_equal(ops.invert(ops.invert(img)).numpy(), img.numpy())
 
 
+@pytest.mark.slow
 def test_randaugment_runs_and_changes_images():
     from sav_tpu.data.autoaugment import distort_image_with_randaugment
 
@@ -96,6 +100,7 @@ def test_randaugment_runs_and_changes_images():
     assert out.shape == img.shape and out.dtype == tf.uint8
 
 
+@pytest.mark.slow
 def test_autoaugment_runs():
     from sav_tpu.data.autoaugment import distort_image_with_autoaugment
 
@@ -149,6 +154,7 @@ def test_cutmix_ratio_matches_area():
     np.testing.assert_allclose(1.0 - ratio, frac_foreign, atol=0.05)
 
 
+@pytest.mark.slow
 def test_mixup_and_cutmix_half_batch_policy():
     from sav_tpu.data.mix import mixup_and_cutmix
 
@@ -176,6 +182,7 @@ def test_mixup_and_cutmix_half_batch_policy():
 # --------------------------------------------------------------- pipeline
 
 
+@pytest.mark.slow
 def test_load_train_in_memory_jpeg_path():
     images, labels = _images(32, size=64)
     it = load(
@@ -198,6 +205,7 @@ def test_load_train_in_memory_jpeg_path():
     assert abs(batch["images"].mean()) < 2.0
 
 
+@pytest.mark.slow
 def test_load_augment_after_mix():
     """augment_before_mix=False runs RA on the re-quantized mixed images
     (reference input_pipeline.py:218-222) and still yields aligned fields."""
@@ -221,6 +229,7 @@ def test_load_augment_after_mix():
     assert abs(batch["images"].mean()) < 2.0
 
 
+@pytest.mark.slow
 def test_load_eval_center_crop():
     images, labels = _images(16, size=64)
     it = load(
@@ -237,6 +246,7 @@ def test_load_eval_center_crop():
     assert "mix_labels" not in batch
 
 
+@pytest.mark.slow
 def test_load_transpose_and_bf16():
     images, labels = _images(16, size=64)
     it = load(
@@ -255,6 +265,7 @@ def test_load_transpose_and_bf16():
     assert batch["images"].dtype.name == "bfloat16"
 
 
+@pytest.mark.slow
 def test_load_batch_dims_nesting():
     images, labels = _images(32, size=64)
     it = load(
@@ -271,6 +282,7 @@ def test_load_batch_dims_nesting():
     assert batch["labels"].shape == (2, 4)
 
 
+@pytest.mark.slow
 def test_load_nested_transpose_layout():
     """Nested batch + transpose: innermost batch dim moves after image dims
     ([d0, H, W, C, d1]) — and fake data matches the real path exactly."""
@@ -294,6 +306,7 @@ def test_load_nested_transpose_layout():
     assert fake["images"].shape == batch["images"].shape
 
 
+@pytest.mark.slow
 def test_load_fake_data():
     it = load(
         Split.TRAIN,
@@ -307,6 +320,7 @@ def test_load_fake_data():
     assert batch["labels"].shape == (2, 4)
 
 
+@pytest.mark.slow
 def test_host_sharding_disjoint():
     from sav_tpu.data.pipeline import _host_shard_range
 
@@ -317,6 +331,7 @@ def test_host_sharding_disjoint():
         assert e0 == s1  # contiguous, disjoint
 
 
+@pytest.mark.slow
 def test_eval_resize_crop_preproc():
     images, labels = _images(8, size=64)
     it = load(
@@ -333,6 +348,7 @@ def test_eval_resize_crop_preproc():
     assert batch["images"].shape == (4, 32, 32, 3)
 
 
+@pytest.mark.slow
 def test_resumable_iterator_replays_batches():
     """Resume at step S replays the uninterrupted run's batch schedule
     bit-exactly (strict determinism replays the augment draws too)."""
@@ -364,6 +380,7 @@ def test_resumable_iterator_replays_batches():
         np.testing.assert_allclose(a["ratio"], b["ratio"], rtol=1e-6)
 
 
+@pytest.mark.slow
 def test_resumable_iterator_epoch_coverage():
     """Each epoch covers every example exactly once (shuffled, no repeat)."""
     from sav_tpu.data.pipeline import resumable_train_iterator

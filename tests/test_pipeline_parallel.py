@@ -55,6 +55,7 @@ def test_pipeline_matches_sequential(devices, num_microbatches):
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=2e-5, atol=2e-5)
 
 
+@pytest.mark.slow
 def test_pipeline_under_jit_with_sharded_params(devices):
     num_stages, dim, batch = 4, 8, 16
     mesh = create_mesh({"pipe": num_stages}, devices=devices[:num_stages])
@@ -73,6 +74,7 @@ def test_pipeline_under_jit_with_sharded_params(devices):
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=2e-5, atol=2e-5)
 
 
+@pytest.mark.slow
 def test_pipeline_gradients_match_sequential(devices):
     num_stages, dim, batch = 4, 8, 16
     mesh = create_mesh({"pipe": num_stages}, devices=devices[:num_stages])
@@ -100,6 +102,7 @@ def test_pipeline_gradients_match_sequential(devices):
     )
 
 
+@pytest.mark.slow
 def test_pipeline_composes_with_data_parallel(devices):
     # 2-way DP × 4-stage PP on the 8-device mesh.
     num_stages, dim, batch = 4, 8, 16

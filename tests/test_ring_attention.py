@@ -10,6 +10,10 @@ from sav_tpu.parallel import create_mesh
 from sav_tpu.parallel.ring_attention import ring_attention
 
 
+
+# Entire module is the expensive tier: mesh/kernel-heavy numerics sweeps.
+pytestmark = pytest.mark.slow
+
 def _qkv(b=2, l=256, h=4, d=32, dtype=jnp.float32):
     ks = jax.random.split(jax.random.PRNGKey(0), 3)
     return tuple(

@@ -24,6 +24,7 @@ def _qkv(b=2, l=17, h=4, d=8, dtype=jnp.float32, seed=0):
 
 @pytest.mark.parametrize("method", ["ring", "ulysses"])
 @pytest.mark.parametrize("length", [16, 17])  # divisible and CLS-odd (pad)
+@pytest.mark.slow
 def test_wrapper_matches_dense(devices, method, length):
     mesh = create_mesh({"data": 4, "seq": 2})
     q, k, v = _qkv(l=length)
@@ -35,6 +36,7 @@ def test_wrapper_matches_dense(devices, method, length):
     np.testing.assert_allclose(got, want, atol=2e-6, rtol=2e-6)
 
 
+@pytest.mark.slow
 def test_wrapper_grads_match_dense(devices):
     mesh = create_mesh({"data": 4, "seq": 2})
     q, k, v = _qkv(l=17)
@@ -64,6 +66,7 @@ def test_ulysses_rejects_indivisible_heads(devices):
 
 
 @pytest.mark.parametrize("method", ["ring", "ulysses"])
+@pytest.mark.slow
 def test_sp_vit_forward_matches_unsharded(devices, method):
     """A 2-way-SP ViT forward equals the plain forward on the same params —
     the acceptance test VERDICT r3 item 5 names. 32² p8 → 17 tokens, so the

@@ -67,6 +67,7 @@ def test_tp_param_specs():
     assert specs["Encoder_0"]["AddAbsPosEmbed_0"]["pos_embed"] == P()
 
 
+@pytest.mark.slow
 def test_dp_and_tp_meshes_agree(devices):
     """Same seed, same data → DP-only and DP×TP runs produce the same loss
     trajectory (the partitioner only changes layouts, not math)."""
@@ -87,6 +88,7 @@ def test_dp_and_tp_meshes_agree(devices):
     np.testing.assert_allclose(losses["dp"], losses["dp_tp"], rtol=2e-4, atol=2e-5)
 
 
+@pytest.mark.slow
 def test_tp_state_actually_sharded(devices):
     mesh = create_mesh({"data": 4, "model": 2})
     cfg = _config(mesh_axes={"data": 4, "model": 2})

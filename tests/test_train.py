@@ -49,6 +49,7 @@ def _trainer(config=None, **model_overrides):
     return Trainer(config, model=model)
 
 
+@pytest.mark.slow
 def test_loss_decreases_on_learnable_data(devices):
     trainer = _trainer()
     state = trainer.init_state()
@@ -71,6 +72,7 @@ def test_state_is_sharded_on_mesh(devices):
     assert len(leaf.sharding.device_set) == 8  # replicated over the full mesh
 
 
+@pytest.mark.slow
 def test_fit_loop_with_eval_and_transpose(devices):
     cfg = _smoke_config(transpose_images=True)
     trainer = _trainer(cfg)
@@ -88,6 +90,7 @@ def test_fit_loop_with_eval_and_transpose(devices):
     assert any("images_per_sec" in h for h in history)
 
 
+@pytest.mark.slow
 def test_batch_stats_model_trains(devices):
     """BatchNorm models thread batch_stats through the same trainer
     (collapses the reference's base.py/base_with_state.py split)."""
@@ -110,6 +113,7 @@ def test_batch_stats_model_trains(devices):
     assert np.isfinite(float(metrics["loss"]))
 
 
+@pytest.mark.slow
 def test_train_many_steps_matches_loop(devices):
     """K scan-fused steps == K separate steps (same math, one dispatch)."""
     it = synthetic_data_iterator(batch_size=16, image_size=32, num_classes=10, seed=5)
@@ -154,6 +158,7 @@ def test_fake_data_shapes():
     assert next(it)["images"].shape == (4, 16, 16, 3)
 
 
+@pytest.mark.slow
 def test_checkpoint_save_restore(tmp_path, devices):
     cfg = _smoke_config(checkpoint_dir=str(tmp_path / "ckpt"))
     trainer = _trainer(cfg)
@@ -174,6 +179,7 @@ def test_checkpoint_save_restore(tmp_path, devices):
     np.testing.assert_allclose(a, b)
 
 
+@pytest.mark.slow
 def test_fit_final_step_on_checkpoint_boundary(tmp_path, devices):
     """Final step landing exactly on an epoch-checkpoint boundary must not
     double-save (orbax raises StepAlreadyExistsError)."""
@@ -212,6 +218,7 @@ def test_schedule_shape():
     assert float(sched(100)) <= 1e-4  # decayed
 
 
+@pytest.mark.slow
 def test_grad_accum_matches_full_batch(devices):
     """K micro-batches, averaged grads → same update as one full batch
     (deterministic model: no dropout/BN, rates are 0 by default)."""
@@ -268,6 +275,7 @@ def test_grad_accum_rejects_indivisible(devices):
         trainer.train_step(state, batch, jax.random.PRNGKey(0))
 
 
+@pytest.mark.slow
 def test_eval_pads_non_divisible_final_batch(devices):
     """50 eval examples in batches of 16 leave a remainder of 2 — not
     divisible by the 8-way data axis. evaluate() must pad + mask instead of
@@ -291,6 +299,7 @@ def test_eval_pads_non_divisible_final_batch(devices):
     assert 0.0 <= metrics["eval_top_1_acc"] <= 1.0
 
 
+@pytest.mark.slow
 def test_eval_tiny_set_smaller_than_mesh(devices):
     """A 3-example eval set on an 8-way data axis must still work."""
     trainer = _trainer()
@@ -304,6 +313,7 @@ def test_eval_tiny_set_smaller_than_mesh(devices):
     assert metrics["eval_count"] == 3.0
 
 
+@pytest.mark.slow
 def test_fused_optimizer_matches_per_leaf():
     """optax.flatten'd Adam (fused_optimizer=True) is numerically identical
     to the per-leaf chain — flatten is a reshape, not an approximation."""
@@ -348,6 +358,7 @@ def _smoke_batch():
     }
 
 
+@pytest.mark.slow
 def test_logits_dtype_isolated_between_trainers(devices):
     """The softmax dtype is a model *attribute*, so trainers with different
     settings coexist structurally — no process state tracks whose step ran
@@ -436,6 +447,7 @@ def test_logits_dtype_external_model_mismatch_raises(devices):
     Trainer(cfg, model=ok)
 
 
+@pytest.mark.slow
 def test_logits_dtype_inherits_compute_dtype(devices):
     """attention_logits_dtype=None resolves to the compute dtype — the
     reference's semantics (its logits einsum runs in the model dtype), so

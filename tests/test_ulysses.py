@@ -10,6 +10,10 @@ from sav_tpu.parallel import create_mesh
 from sav_tpu.parallel.ulysses import ulysses_attention
 
 
+
+# Entire module is the expensive tier: mesh/kernel-heavy numerics sweeps.
+pytestmark = pytest.mark.slow
+
 def _qkv(b=2, l=256, h=8, d=32, dtype=jnp.float32):
     ks = jax.random.split(jax.random.PRNGKey(0), 3)
     return tuple(jax.random.normal(k, (b, l, h, d), dtype) for k in ks)

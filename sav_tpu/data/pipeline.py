@@ -473,7 +473,11 @@ def load(
             # training). Post-augment images may already be uint8 (RA/AA
             # output); float crop output is requantized round-to-nearest,
             # bounding the deviation at 0.5/255 — the same quantization
-            # the augment stage applies whenever RA/AA runs.
+            # the augment stage applies whenever RA/AA runs. NOTE this
+            # also covers EVAL batches: the bilinear-resized crop is
+            # float, so eval in this mode deviates ≤0.5/255/pixel from
+            # the standard path — eval top-1 between modes is equal in
+            # expectation but not bit-identical (ADVICE r3).
             if batch["images"].dtype != tf.uint8:
                 batch["images"] = tf.cast(
                     tf.clip_by_value(tf.round(batch["images"]), 0.0, 255.0),

@@ -37,6 +37,8 @@ class EncoderBlock(nn.Module):
     dropout_rate: float = 0.0
     backend: Optional[str] = None
     logits_dtype: Optional[Dtype] = None  # None = inherit dtype (softmax math)
+    seq_parallel: Optional[str] = None
+    seq_mesh: Optional[Any] = None
     dtype: Dtype = jnp.float32
 
     @nn.compact
@@ -47,6 +49,8 @@ class EncoderBlock(nn.Module):
             out_dropout_rate=self.dropout_rate,
             backend=self.backend,
             logits_dtype=self.logits_dtype,
+            seq_parallel=self.seq_parallel,
+            seq_mesh=self.seq_mesh,
             dtype=self.dtype,
         )(inputs, is_training)
         x = nn.LayerNorm(dtype=self.dtype)(x + inputs)
@@ -66,6 +70,10 @@ class CeiT(nn.Module):
     dropout_rate: float = 0.0
     backend: Optional[str] = None
     logits_dtype: Optional[Dtype] = None  # None = inherit dtype (softmax math)
+    # SP shards the trunk token sequence; the LCA head (single-query class
+    # attention over L_layers CLS tokens) stays unsharded.
+    seq_parallel: Optional[str] = None
+    seq_mesh: Optional[Any] = None
     dtype: Dtype = jnp.float32
 
     @nn.compact
@@ -92,6 +100,8 @@ class CeiT(nn.Module):
                 dropout_rate=self.dropout_rate,
                 backend=self.backend,
                 logits_dtype=self.logits_dtype,
+                seq_parallel=self.seq_parallel,
+                seq_mesh=self.seq_mesh,
                 dtype=self.dtype,
                 name=f"block_{i}",
             )(x, is_training)

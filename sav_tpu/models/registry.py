@@ -174,8 +174,9 @@ def create_model(
         every attention block (None = inherit ``dtype``, the reference's
         semantics; 'float32' forces f32 softmax under bf16 compute).
       seq_parallel: 'ring' | 'ulysses' — route self-attention through
-        sequence parallelism over ``seq_mesh``'s 'seq' axis (ViT family;
-        sav_tpu.parallel.seq_parallel).
+        sequence parallelism over ``seq_mesh``'s 'seq' axis
+        (sav_tpu.parallel.seq_parallel; ViT/DeiT every block, TNT outer
+        stream, CeiT trunk — others raise).
       seq_mesh: the jax.sharding.Mesh carrying the 'seq' axis; required
         with ``seq_parallel``.
       **overrides: per-call hyperparameter overrides.
@@ -195,7 +196,9 @@ def create_model(
         if "seq_parallel" not in cls.__dataclass_fields__:
             raise ValueError(
                 f"{model_name!r} does not support sequence parallelism "
-                "(ViT-family self-attention models only)"
+                "(SP-capable: ViT/DeiT, TNT outer stream, CeiT trunk; "
+                "CaiT is talking-heads, CvT conv-projected, BoTNet "
+                "2-D-bias — their cores keep the dense path)"
             )
         merged["seq_parallel"] = seq_parallel
         merged["seq_mesh"] = seq_mesh

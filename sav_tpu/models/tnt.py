@@ -86,6 +86,10 @@ class EncoderBlock(nn.Module):
     dropout_rate: float = 0.0
     backend: Optional[str] = None
     logits_dtype: Optional[Dtype] = None  # None = inherit dtype (softmax math)
+    # SP shards the OUTER (patch-token) sequence only: the inner stream's
+    # per-patch sequences are tiny and already parallel over B*P.
+    seq_parallel: Optional[str] = None
+    seq_mesh: Optional[Any] = None
     dtype: Dtype = jnp.float32
 
     @nn.compact
@@ -125,6 +129,8 @@ class EncoderBlock(nn.Module):
             out_dropout_rate=self.dropout_rate,
             backend=self.backend,
             logits_dtype=self.logits_dtype,
+            seq_parallel=self.seq_parallel,
+            seq_mesh=self.seq_mesh,
             dtype=self.dtype,
             name="outer_attn",
         )(z, is_training)
@@ -155,6 +161,8 @@ class TNT(nn.Module):
     dropout_rate: float = 0.0
     backend: Optional[str] = None
     logits_dtype: Optional[Dtype] = None  # None = inherit dtype (softmax math)
+    seq_parallel: Optional[str] = None  # outer-stream SP; see EncoderBlock
+    seq_mesh: Optional[Any] = None
     dtype: Dtype = jnp.float32
 
     @nn.compact
@@ -194,6 +202,8 @@ class TNT(nn.Module):
                 dropout_rate=self.dropout_rate,
                 backend=self.backend,
                 logits_dtype=self.logits_dtype,
+                seq_parallel=self.seq_parallel,
+                seq_mesh=self.seq_mesh,
                 dtype=self.dtype,
                 name=f"block_{i}",
             )(pixel_tokens, patch_tokens, is_training)

@@ -65,20 +65,28 @@ def test_ulysses_rejects_indivisible_heads(devices):
         sequence_parallel_attention(q, k, v, mesh=mesh, method="ulysses")
 
 
-@pytest.mark.parametrize("method", ["ring", "ulysses"])
 @pytest.mark.slow
-def test_sp_vit_forward_matches_unsharded(devices, method):
-    """A 2-way-SP ViT forward equals the plain forward on the same params —
-    the acceptance test VERDICT r3 item 5 names. 32² p8 → 17 tokens, so the
-    pad-and-mask path is what runs."""
+@pytest.mark.parametrize("name,method,kwargs", [
+    # ViT: every block SP-routed; 32² p8 → 17 tokens exercises pad-and-mask
+    # (the acceptance test VERDICT r3 item 5 names). Both methods.
+    ("vit_ti_patch16", "ring",
+     dict(num_layers=2, embed_dim=64, num_heads=4, patch_shape=(8, 8))),
+    ("vit_ti_patch16", "ulysses",
+     dict(num_layers=2, embed_dim=64, num_heads=4, patch_shape=(8, 8))),
+    # TNT shards its outer patch-token stream only.
+    ("tnt_s_patch16", "ring",
+     dict(num_layers=2, embed_dim=64, inner_ch=12, num_heads=4,
+          inner_num_heads=2, patch_shape=(8, 8))),
+    # CeiT shards its trunk; the LCA head stays unsharded.
+    ("ceit_t", "ring", dict(num_layers=2, embed_dim=64, num_heads=4)),
+])
+def test_sp_model_forward_matches_unsharded(devices, name, method, kwargs):
+    """A 2-way-SP forward equals the plain forward on the same params for
+    every SP-capable family."""
     mesh = create_mesh({"data": 4, "seq": 2})
-    kwargs = dict(
-        num_classes=10, num_layers=2, embed_dim=64, num_heads=4,
-        patch_shape=(8, 8),
-    )
-    dense = create_model("vit_ti_patch16", **kwargs)
+    dense = create_model(name, num_classes=10, **kwargs)
     sp = create_model(
-        "vit_ti_patch16", seq_parallel=method, seq_mesh=mesh, **kwargs
+        name, num_classes=10, seq_parallel=method, seq_mesh=mesh, **kwargs
     )
     x = jax.random.normal(jax.random.PRNGKey(0), (4, 32, 32, 3), jnp.float32)
     variables = dense.init({"params": jax.random.PRNGKey(1)}, x, is_training=False)
@@ -92,7 +100,7 @@ def test_sp_vit_forward_matches_unsharded(devices, method):
         jax.jit(lambda v, x: sp.apply(v, x, is_training=False))(variables, x),
         np.float32,
     )
-    np.testing.assert_allclose(got, want, atol=1e-5, rtol=1e-5)
+    np.testing.assert_allclose(got, want, atol=2e-5, rtol=2e-5)
 
 
 def test_sp_model_requires_mesh(devices):

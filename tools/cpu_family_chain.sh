@@ -1,0 +1,30 @@
+#!/bin/bash
+# CPU-fallback chain for the per-family digits runs (VERDICT r3 item 4
+# insurance while the TPU relay is down). Runs families sequentially on
+# host CPU; before each family, yields permanently if the r4 battery has
+# claimed the relay (the TPU runs the same presets ~50x faster, and a
+# CPU-bound trainer would starve the 1-core host pipeline feeding it).
+set -u
+cd /root/repo
+LOG=.tpu_results/cpu_chain_log
+echo "$(date) chain start" > "$LOG"
+for fam in cvt botnet tnt ceit mixer; do
+  if grep -q "TPU is back" .tpu_results/r4_log 2>/dev/null; then
+    echo "$(date) relay battery active — yielding (TPU runs the rest)" >> "$LOG"
+    exit 0
+  fi
+  if [ -s ".tpu_results/train_${fam}.out" ]; then
+    echo "$(date) skip $fam (TPU battery already produced it)" >> "$LOG"
+    continue
+  fi
+  echo "$(date) START $fam (cpu)" >> "$LOG"
+  timeout 14400 env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu python train.py \
+    --preset "${fam}_digits" --platform cpu --data-dir .data/digits \
+    --num-train-images 1438 --num-eval-images 359 \
+    --crop-min-area 0.5 --no-train-flip \
+    -c ".ckpt/${fam}_digits_cpu" --seed 42 \
+    > ".tpu_results/train_${fam}_cpu.out" 2>&1
+  rc=$?  # captured before the $(date) substitution can clobber $?
+  echo "$(date) DONE $fam (rc=$rc)" >> "$LOG"
+done
+echo "$(date) chain complete" >> "$LOG"

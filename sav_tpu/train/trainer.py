@@ -216,6 +216,17 @@ class Trainer:
     # ----------------------------------------------------------------- steps
 
     def _prep_images(self, images: jax.Array) -> jax.Array:
+        if images.dtype == jnp.uint8:
+            # uint8 batches belong to device_preprocess=True (which
+            # normalizes on device); a plain astype here would silently
+            # train on unnormalized 0..255 values (ADVICE r3). Trace-time
+            # check — dtypes are static under jit.
+            raise ValueError(
+                "got uint8 images with device_preprocess=False; either set "
+                "TrainConfig.device_preprocess=True or feed normalized "
+                "float batches (load(device_preprocess=...) must match the "
+                "trainer)"
+            )
         if self.config.transpose_images and images.ndim == 4:
             # HWCN → NHWC (the reference's double-transpose trick lands the
             # device-side transpose here, train.py:80).
@@ -242,6 +253,19 @@ class Trainer:
         from sav_tpu.ops import preprocess as pp
 
         images = batch["images"]
+        if images.dtype != jnp.uint8:
+            # The device_preprocess contract ships post-augment 0..255
+            # uint8 (load(device_preprocess=True) / savrec
+            # normalize=False); an already-normalized float batch here
+            # would be normalized twice — silently wrong training
+            # (ADVICE r3). Trace-time check: dtypes are static under jit.
+            raise ValueError(
+                "device_preprocess=True expects uint8 batches from the "
+                f"matching pipeline mode, got {images.dtype}; feed "
+                "load(device_preprocess=True) / "
+                "savrec_train_iterator(normalize=False) batches, or turn "
+                "device_preprocess off"
+            )
         if self.config.transpose_images and images.ndim == 4:
             images = jnp.transpose(images, (3, 0, 1, 2))  # HWCN → NHWC
         batch = dict(batch)

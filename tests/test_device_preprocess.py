@@ -242,3 +242,43 @@ def test_savrec_raw_path_rejects_transpose(tmp_path):
                 transpose=True,
             )
         )
+
+
+def test_mode_mismatch_fails_loudly(devices):
+    """device_preprocess wiring mistakes must not train silently wrong
+    (ADVICE r3): uint8 into a float-path trainer and floats into a
+    device-preprocess trainer both raise at trace time."""
+    from sav_tpu.train import TrainConfig, Trainer
+
+    def smoke_config(**kw):
+        return TrainConfig(
+            model_name="vit_ti_patch16",
+            num_classes=10,
+            image_size=32,
+            compute_dtype="float32",
+            global_batch_size=8,
+            num_train_images=32,
+            num_epochs=2,
+            warmup_epochs=1,
+            transpose_images=False,
+            augment="cutmix_mixup",
+            model_overrides=dict(num_layers=1, embed_dim=32, num_heads=2),
+            seed=0,
+            **kw,
+        )
+
+    rng = jax.random.PRNGKey(0)
+    u8 = {
+        "images": np.zeros((8, 32, 32, 3), np.uint8),
+        "labels": np.zeros((8,), np.int32),
+    }
+    f32 = {
+        "images": np.zeros((8, 32, 32, 3), np.float32),
+        "labels": np.zeros((8,), np.int32),
+    }
+    plain = Trainer(smoke_config())
+    with pytest.raises(ValueError, match="uint8"):
+        plain.train_step(plain.init_state(0), u8, rng)
+    devpp = Trainer(smoke_config(device_preprocess=True))
+    with pytest.raises(ValueError, match="uint8"):
+        devpp.train_step(devpp.init_state(0), f32, rng)

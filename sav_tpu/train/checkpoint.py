@@ -16,15 +16,26 @@ import orbax.checkpoint as ocp
 
 
 class Checkpointer:
-    def __init__(self, directory: str, *, keep: int = 3):
+    def __init__(self, directory: str, *, keep: int = 3, read_only: bool = False):
+        """``read_only`` opens an existing checkpoint dir for restore-only
+        use (warm starts): no directory creation — a typo'd path raises
+        instead of materializing an empty dir — and no retention policy."""
         self._dir = os.path.abspath(directory)
-        os.makedirs(self._dir, exist_ok=True)
-        self._mgr = ocp.CheckpointManager(
-            self._dir,
-            options=ocp.CheckpointManagerOptions(
+        if read_only:
+            if not os.path.isdir(self._dir):
+                raise FileNotFoundError(
+                    f"checkpoint directory does not exist: {self._dir!r}"
+                )
+            try:
+                options = ocp.CheckpointManagerOptions(read_only=True)
+            except TypeError:  # older orbax without the flag
+                options = ocp.CheckpointManagerOptions(create=False)
+        else:
+            os.makedirs(self._dir, exist_ok=True)
+            options = ocp.CheckpointManagerOptions(
                 max_to_keep=keep, create=True, enable_async_checkpointing=True
-            ),
-        )
+            )
+        self._mgr = ocp.CheckpointManager(self._dir, options=options)
 
     @property
     def directory(self) -> str:
@@ -46,6 +57,20 @@ class Checkpointer:
             return None
         abstract = jax.tree.map(ocp.utils.to_shape_dtype_struct, template)
         return self._mgr.restore(step, args=ocp.args.StandardRestore(abstract))
+
+    def restore_raw(self, step: Optional[int] = None) -> Optional[Any]:
+        """Restore a checkpoint in its *saved* structure (no template).
+
+        For warm starts across architectures/resolutions, where the saved
+        shapes deliberately differ from the current state's (e.g. the
+        224-pretrain position table loaded into a 384 finetune —
+        ``sav_tpu.models.surgery`` resamples it afterwards).
+        """
+        if step is None:
+            step = self._mgr.latest_step()
+        if step is None:
+            return None
+        return self._mgr.restore(step, args=ocp.args.StandardRestore())
 
     def wait(self) -> None:
         self._mgr.wait_until_finished()

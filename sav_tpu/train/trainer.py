@@ -183,6 +183,81 @@ class Trainer:
         state = jax.jit(init_fn, out_shardings=shardings)(rng)
         return state
 
+    def warm_start_from(self, directory: str) -> TrainState:
+        """Fresh state (step 0, fresh optimizer) with params/batch_stats
+        loaded from another run's checkpoint — the finetune path the
+        reference lacked entirely (its restore was never wired,
+        /root/reference/train.py:123-127, SURVEY.md §5).
+
+        Cross-resolution transfers follow the standard ViT recipe
+        (DeiT/CaiT 224-pretrain → 384-finetune): ``pos_embed`` tables are
+        bicubic-resampled to the new token count
+        (:mod:`sav_tpu.models.surgery`). Any other shape mismatch (e.g. a
+        different-width head for a new label space) keeps the fresh
+        initialization for that leaf, logged — classic warm-start
+        semantics.
+        """
+        import logging
+
+        from sav_tpu.models.surgery import adapt_pos_embeds
+
+        source = Checkpointer(directory, read_only=True)
+        try:
+            raw = source.restore_raw()
+        finally:
+            source.close()
+        if raw is None:
+            raise FileNotFoundError(f"no checkpoint found in {directory!r}")
+        src_params = raw["params"] if isinstance(raw, dict) else raw.params
+        src_stats = (
+            raw.get("batch_stats", {}) if isinstance(raw, dict)
+            else raw.batch_stats
+        )
+        fresh = self.init_state()
+        src_params = adapt_pos_embeds(src_params, fresh.params)
+        counts = {"transferred": 0, "fresh": 0}
+
+        def merge(tree_src, tree_fresh, collection):
+            flat_src = {
+                tuple(p): l
+                for p, l in jax.tree_util.tree_flatten_with_path(tree_src)[0]
+            }
+
+            def pick(path, fresh_leaf):
+                src = flat_src.get(tuple(path))
+                name = "/".join(str(getattr(k, "key", k)) for k in path)
+                if src is None or src.shape != fresh_leaf.shape:
+                    # warning level: the default unconfigured logger drops
+                    # info, and a silently-fresh "warm start" (e.g. wrong
+                    # model_overrides failing every shape check) must be
+                    # visible.
+                    logging.warning(
+                        "warm start: %s %s %s; keeping fresh init",
+                        collection, name,
+                        "not in source" if src is None
+                        else f"shape {src.shape} != {fresh_leaf.shape}",
+                    )
+                    counts["fresh"] += 1
+                    return fresh_leaf
+                counts["transferred"] += 1
+                return jax.device_put(
+                    jnp.asarray(src, dtype=fresh_leaf.dtype),
+                    fresh_leaf.sharding,
+                )
+
+            return jax.tree_util.tree_map_with_path(pick, tree_fresh)
+
+        params = merge(src_params, fresh.params, "params")
+        stats = (
+            merge(src_stats, fresh.batch_stats, "batch_stats")
+            if fresh.batch_stats else fresh.batch_stats
+        )
+        logging.warning(
+            "warm start from %s: %d leaves transferred, %d fresh",
+            directory, counts["transferred"], counts["fresh"],
+        )
+        return fresh.replace(params=params, batch_stats=stats)
+
     def restore_or_init(self) -> TrainState:
         state = self.init_state()
         if self.checkpointer is not None:

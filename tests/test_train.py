@@ -488,3 +488,34 @@ def test_logits_dtype_inherits_compute_dtype(devices):
         forced.apply(variables, x, is_training=False), np.float32
     )
     assert not np.array_equal(out_bf16, out_f32)
+
+
+@pytest.mark.slow
+def test_warm_start_cross_resolution(tmp_path, devices):
+    """--init-from semantics: params transfer, pos_embed resampled to the
+    new token count (224->384-style finetune), step/optimizer fresh."""
+    overrides = dict(
+        num_layers=1, embed_dim=32, num_heads=2, patch_shape=(8, 8)
+    )
+    cfg32 = _smoke_config(
+        checkpoint_dir=str(tmp_path / "pre"), model_overrides=overrides
+    )
+    pre = Trainer(cfg32)
+    state = pre.init_state(0)
+    batch = _smoke_batch()
+    state, _ = pre.train_step(state, batch, jax.random.PRNGKey(0))
+    pre.checkpointer.save(1, state)
+    pre.checkpointer.wait()
+
+    cfg48 = _smoke_config(image_size=48, model_overrides=overrides)
+    fine = Trainer(cfg48)
+    warm = fine.warm_start_from(str(tmp_path / "pre"))
+    assert int(jax.device_get(warm.step)) == 0  # fresh step + optimizer
+    # pos_embed resampled: 32/8 -> 17 tokens, 48/8 -> 37 tokens.
+    pe = warm.params["Encoder_0"]["AddAbsPosEmbed_0"]["pos_embed"]
+    assert pe.shape[1] == 37
+    # Non-positional leaves transfer exactly.
+    np.testing.assert_array_equal(
+        jax.device_get(warm.params["head"]["kernel"]),
+        jax.device_get(state.params["head"]["kernel"]),
+    )

@@ -78,6 +78,18 @@ import click
     help="Named experiment preset (sav_tpu.train.presets); CLI flags override.",
 )
 @click.option("-c", "--checkpoint-dir", type=str, default=None)
+@click.option(
+    "--init-from", type=str, default=None,
+    help="Warm-start params/batch_stats from another run's checkpoint dir "
+    "(fresh step/optimizer). Cross-resolution finetunes resample the "
+    "pos_embed tables (the 224-pretrain -> 384-finetune ViT recipe); "
+    "other shape mismatches keep fresh init. A resumable checkpoint in "
+    "-c takes precedence (preemption-safe resume beats re-warm-starting).",
+)
+@click.option(
+    "--eval-only", is_flag=True,
+    help="Restore from -c and run one evaluation pass; no training.",
+)
 @click.option("--steps", type=int, default=None, help="Override total steps.")
 @click.option(
     "--num-train-images", type=int, default=None,
@@ -123,8 +135,8 @@ def main(
     ctx, data_dir, fake_data, model_name, num_classes, image_size, batch_size,
     num_epochs, warmup_epochs, learning_rate, weight_decay, label_smoothing,
     clip_grad, grad_accum, augmentation, patch_size, backend, logits_dtype,
-    remat, dtype, tp, fsdp, sp, sp_method, preset, checkpoint_dir, steps,
-    num_train_images,
+    remat, dtype, tp, fsdp, sp, sp_method, preset, checkpoint_dir, init_from,
+    eval_only, steps, num_train_images,
     num_eval_images, crop_min_area, train_flip, platform, fused_optimizer,
     device_preprocess, seed,
 ):
@@ -291,8 +303,46 @@ def main(
     # iterator position on preemption — train.py never even restored).
     state = trainer.restore_or_init()
     start_step = int(jax.device_get(state.step))
+    if init_from and start_step == 0:
+        # Only when -c held no resumable checkpoint: a preemption-safe
+        # resume must win over re-warm-starting from the pretrain.
+        state = trainer.warm_start_from(init_from)
 
     per_host_batch = batch_size // jax.process_count()
+
+    def eval_iter_fn():
+        return load(
+            Split.TEST,
+            data_dir=data_dir,
+            is_training=False,
+            batch_dims=[per_host_batch],
+            image_size=image_size,
+            transpose=config.transpose_images,
+            bfloat16=dtype == "bfloat16",
+            device_preprocess=config.device_preprocess,
+            fake_data=fake_data,
+            split_examples=num_eval_images,
+        )
+
+    if eval_only:
+        if start_step == 0 and not init_from:
+            # Freshly initialized weights would produce plausible-looking
+            # chance-level metrics — refuse rather than mislead.
+            raise click.UsageError(
+                "--eval-only found no checkpoint to evaluate: -c holds "
+                "none and --init-from was not given"
+            )
+        eval_iter = eval_iter_fn()
+        if fake_data:
+            # The fake stream is infinite (it exists to exercise shapes,
+            # not epochs) — bound the smoke eval.
+            import itertools
+
+            eval_iter = itertools.islice(eval_iter, 4)
+        metrics = trainer.evaluate(state, eval_iter)
+        if jax.process_index() == 0:
+            click.echo(json.dumps({"step": start_step, **metrics}))
+        return
     if fake_data:
         train_iter = load(
             Split.TRAIN,
@@ -324,20 +374,6 @@ def main(
             split_examples=num_train_images,
             crop_area_range=(crop_min_area, 1.0),
             random_flip=train_flip,
-        )
-
-    def eval_iter_fn():
-        return load(
-            Split.TEST,
-            data_dir=data_dir,
-            is_training=False,
-            batch_dims=[per_host_batch],
-            image_size=image_size,
-            transpose=config.transpose_images,
-            bfloat16=dtype == "bfloat16",
-            device_preprocess=config.device_preprocess,
-            fake_data=fake_data,
-            split_examples=num_eval_images,
         )
 
     def log_fn(metrics):

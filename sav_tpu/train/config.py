@@ -72,6 +72,11 @@ class TrainConfig:
     # opt-state layout of pre-round-3 checkpoints.
     fused_optimizer: Optional[bool] = None
     label_smoothing: float = 0.1
+    # Parameter EMA (e.g. 0.9999): eval runs on the averaged weights (the
+    # DeiT/CaiT-recipe standard). Lives in opt_state
+    # (optimizer.track_params_ema), so it checkpoints/shards with the rest;
+    # None keeps the opt-state layout of EMA-less checkpoints.
+    ema_decay: Optional[float] = None
     aux_loss_weight: float = 0.01  # weight on sown 'losses' (MoE balance etc.)
     grad_accum_steps: int = 1  # micro-batches per optimizer update
     seed: int = 42

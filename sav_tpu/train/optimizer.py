@@ -9,10 +9,59 @@ optimizers, plus global-norm clipping (train.py:25).
 
 from __future__ import annotations
 
-from typing import Any, Optional
+from typing import Any, NamedTuple, Optional
 
 import jax
+import jax.numpy as jnp
 import optax
+
+
+class EmaState(NamedTuple):
+    """Exponential moving average of the *parameters* (not gradients)."""
+
+    ema: Any
+
+
+def track_params_ema(decay: float) -> optax.GradientTransformation:
+    """Maintain ``ema = decay·ema + (1-decay)·params`` as optimizer state.
+
+    Must sit LAST in the optax chain: it applies the (final) updates to the
+    incoming params to see the post-step values, and passes the updates
+    through unchanged. Living inside ``opt_state`` means the EMA rides
+    checkpoints, sharding rules (path-suffix matching places the mirror
+    tree like its parameters), and donation for free — no TrainState
+    change, so checkpoints from EMA-less configs keep restoring.
+    """
+    if not 0.0 <= decay <= 1.0:
+        raise ValueError(f"ema decay must be in [0, 1], got {decay}")
+
+    def init_fn(params):
+        return EmaState(ema=jax.tree.map(lambda p: p.astype(jnp.float32), params))
+
+    def update_fn(updates, state, params=None):
+        if params is None:
+            raise ValueError("track_params_ema requires params")
+        new_params = optax.apply_updates(params, updates)
+        ema = jax.tree.map(
+            lambda e, p: decay * e + (1.0 - decay) * p.astype(e.dtype),
+            state.ema,
+            new_params,
+        )
+        return updates, EmaState(ema=ema)
+
+    return optax.GradientTransformation(init_fn, update_fn)
+
+
+def ema_params(opt_state) -> Optional[Any]:
+    """Extract the EMA parameter tree from an optimizer state, or None."""
+    found = [
+        s.ema
+        for s in jax.tree_util.tree_leaves(
+            opt_state, is_leaf=lambda x: isinstance(x, EmaState)
+        )
+        if isinstance(s, EmaState)
+    ]
+    return found[0] if found else None
 
 
 def warmup_cosine_schedule(
@@ -58,6 +107,7 @@ def make_optimizer(
     weight_decay: float = 0.05,
     clip_grad_norm: Optional[float] = 1.0,
     fused: bool = True,
+    ema_decay: Optional[float] = None,
 ) -> optax.GradientTransformation:
     """Masked AdamW, by default with the Adam moment math on one flat vector.
 
@@ -81,4 +131,7 @@ def make_optimizer(
         optax.add_decayed_weights(weight_decay, mask=weight_decay_mask),
         optax.scale_by_learning_rate(schedule),
     ]
+    if ema_decay is not None:
+        # Last: sees the final updates, so the EMA tracks post-step params.
+        chain.append(track_params_ema(ema_decay))
     return optax.chain(*chain)

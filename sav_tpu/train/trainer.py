@@ -30,7 +30,12 @@ from sav_tpu.parallel.mesh import batch_axes, create_mesh
 from sav_tpu.parallel.sharding import param_shardings
 from sav_tpu.train.checkpoint import Checkpointer
 from sav_tpu.train.config import TrainConfig
-from sav_tpu.train.optimizer import make_optimizer, warmup_cosine_schedule
+from sav_tpu.train.optimizer import (
+    EmaState,
+    ema_params,
+    make_optimizer,
+    warmup_cosine_schedule,
+)
 from sav_tpu.train.state import TrainState
 from sav_tpu.utils import profiler
 from sav_tpu.utils.debug import assert_all_finite
@@ -142,6 +147,7 @@ class Trainer:
             weight_decay=config.weight_decay,
             clip_grad_norm=config.clip_grad_norm,
             fused=fused_opt,
+            ema_decay=config.ema_decay,
         )
         self.checkpointer = checkpointer
         if checkpointer is None and config.checkpoint_dir:
@@ -256,7 +262,30 @@ class Trainer:
             "warm start from %s: %d leaves transferred, %d fresh",
             directory, counts["transferred"], counts["fresh"],
         )
-        return fresh.replace(params=params, batch_stats=stats)
+        # Reseed the parameter EMA (if configured) from the TRANSFERRED
+        # weights: tx.init built it from the random init, and eval-on-EMA
+        # would otherwise spend ~1/(1-decay) steps converging back from
+        # garbage on exactly the short finetunes EMA is meant to help.
+        opt_state = jax.tree_util.tree_map(
+            lambda s: (
+                EmaState(
+                    ema=jax.tree.map(
+                        lambda e, p: jax.device_put(
+                            jnp.asarray(p, e.dtype), e.sharding
+                        ),
+                        s.ema,
+                        params,
+                    )
+                )
+                if isinstance(s, EmaState)
+                else s
+            ),
+            fresh.opt_state,
+            is_leaf=lambda x: isinstance(x, EmaState),
+        )
+        return fresh.replace(
+            params=params, batch_stats=stats, opt_state=opt_state
+        )
 
     def restore_or_init(self) -> TrainState:
         state = self.init_state()
@@ -278,10 +307,14 @@ class Trainer:
                 if mismatch:
                     raise RuntimeError(
                         "checkpoint restore failed with a state-structure "
-                        "mismatch; if this checkpoint predates the "
-                        "flat-buffer optimizer (round 3), rerun with "
-                        "--no-fused-optimizer (TrainConfig.fused_optimizer="
-                        "False) to keep the per-leaf Adam state layout"
+                        "mismatch; two config knobs change the opt-state "
+                        "layout and must match the checkpoint: (a) "
+                        "--ema-decay (TrainConfig.ema_decay) adds an EMA "
+                        "tree — set it iff the checkpointed run had it; "
+                        "(b) checkpoints predating the flat-buffer "
+                        "optimizer (round 3) need --no-fused-optimizer "
+                        "(TrainConfig.fused_optimizer=False) for the "
+                        "per-leaf Adam state layout"
                     ) from e
                 raise
             if restored is not None:
@@ -493,7 +526,16 @@ class Trainer:
             images = batch["images"]
         else:
             images = self._prep_images(batch["images"])
-        variables = {"params": state.params}
+        # Eval on the parameter EMA when configured (the DeiT/CaiT-recipe
+        # standard: the averaged weights generalize better than the last
+        # step's). The EMA tree lives in opt_state (optimizer.py
+        # track_params_ema) and mirrors the params' shardings.
+        params = state.params
+        if self.config.ema_decay is not None:
+            ema = ema_params(state.opt_state)
+            if ema is not None:
+                params = ema
+        variables = {"params": params}
         if state.batch_stats:
             variables["batch_stats"] = state.batch_stats
         logits = self.model.apply(variables, images, is_training=False)

@@ -156,6 +156,40 @@ def test_trainer_sp_train_step(devices):
     assert np.isfinite(float(jax.device_get(em["loss_sum"])))
 
 
+@pytest.mark.slow
+def test_trainer_sp_composes_with_grad_accum(devices):
+    """SP attention inside the microbatched grad-accum step: the shard_map
+    runs under lax.scan's body — a distinct trace path from the plain
+    step."""
+    config = TrainConfig(
+        model_name="vit_ti_patch16",
+        num_classes=10,
+        image_size=32,
+        compute_dtype="float32",
+        global_batch_size=8,
+        num_train_images=32,
+        num_epochs=2,
+        warmup_epochs=1,
+        base_lr=1e-3,
+        grad_accum_steps=2,
+        transpose_images=False,
+        mesh_axes={"data": 4, "seq": 2},
+        sequence_parallel="ring",
+        model_overrides=dict(num_layers=2, embed_dim=64, num_heads=4),
+        seed=0,
+    )
+    trainer = Trainer(config)
+    batch = {
+        "images": np.random.default_rng(0)
+        .normal(size=(8, 32, 32, 3))
+        .astype(np.float32),
+        "labels": (np.arange(8) % 10).astype(np.int32),
+    }
+    state = trainer.init_state(0)
+    state, metrics = trainer.train_step(state, batch, jax.random.PRNGKey(0))
+    assert np.isfinite(float(jax.device_get(metrics["loss"])))
+
+
 def test_trainer_sp_requires_seq_axis(devices):
     config = TrainConfig(
         model_name="vit_ti_patch16",

@@ -507,7 +507,9 @@ def test_warm_start_cross_resolution(tmp_path, devices):
     pre.checkpointer.save(1, state)
     pre.checkpointer.wait()
 
-    cfg48 = _smoke_config(image_size=48, model_overrides=overrides)
+    cfg48 = _smoke_config(
+        image_size=48, model_overrides=overrides, ema_decay=0.999
+    )
     fine = Trainer(cfg48)
     warm = fine.warm_start_from(str(tmp_path / "pre"))
     assert int(jax.device_get(warm.step)) == 0  # fresh step + optimizer
@@ -519,3 +521,61 @@ def test_warm_start_cross_resolution(tmp_path, devices):
         jax.device_get(warm.params["head"]["kernel"]),
         jax.device_get(state.params["head"]["kernel"]),
     )
+    # The parameter EMA is reseeded from the TRANSFERRED weights, not the
+    # random init tx.init saw (eval-on-EMA would otherwise start from
+    # garbage on short finetunes).
+    from sav_tpu.train.optimizer import ema_params
+
+    ema = ema_params(warm.opt_state)
+    np.testing.assert_array_equal(
+        jax.device_get(ema["head"]["kernel"]),
+        jax.device_get(state.params["head"]["kernel"]),
+    )
+
+
+def test_ema_tracks_post_step_params(devices):
+    """track_params_ema sits last in the chain, so after one step
+    ema == decay·p0 + (1−decay)·p1 exactly; eval runs on the EMA tree."""
+    from sav_tpu.train.optimizer import ema_params
+
+    decay = 0.5
+    cfg = _smoke_config(
+        ema_decay=decay, model_overrides=_small_model_overrides()
+    )
+    trainer = Trainer(cfg)
+    state0 = trainer.init_state(0)
+    p0 = jax.device_get(jax.tree.leaves(state0.params)[0])
+    ema0 = jax.device_get(jax.tree.leaves(ema_params(state0.opt_state))[0])
+    np.testing.assert_array_equal(ema0, p0)  # init: ema == params
+
+    batch = _smoke_batch()
+    state1, _ = trainer.train_step(state0, batch, jax.random.PRNGKey(0))
+    p1 = jax.device_get(jax.tree.leaves(state1.params)[0])
+    ema1 = jax.device_get(jax.tree.leaves(ema_params(state1.opt_state))[0])
+    np.testing.assert_allclose(
+        ema1, decay * p0 + (1 - decay) * p1, rtol=1e-6, atol=1e-7
+    )
+
+
+@pytest.mark.slow
+def test_eval_uses_ema_params(devices):
+    """With decay=1.0 the EMA never moves off the init — eval metrics must
+    match a fresh model's even after training steps moved the live params."""
+    overrides = _small_model_overrides()
+    frozen = Trainer(_smoke_config(ema_decay=1.0, model_overrides=overrides))
+    live = Trainer(_smoke_config(model_overrides=overrides))
+    batch = _smoke_batch()
+    rng = jax.random.PRNGKey(0)
+
+    fs = frozen.init_state(0)
+    ls = live.init_state(0)
+    baseline = float(jax.device_get(frozen.eval_step(fs, batch)["loss_sum"]))
+    for i in range(3):
+        fs, _ = frozen.train_step(fs, batch, rng)
+        ls, _ = live.train_step(ls, batch, rng)
+    after_frozen = float(jax.device_get(frozen.eval_step(fs, batch)["loss_sum"]))
+    after_live = float(jax.device_get(live.eval_step(ls, batch)["loss_sum"]))
+    # decay=1.0: eval-on-EMA pinned to the init weights...
+    np.testing.assert_allclose(after_frozen, baseline, rtol=1e-5)
+    # ...while the same steps moved the live trainer's eval.
+    assert abs(after_live - baseline) > 1e-3

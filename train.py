@@ -31,6 +31,11 @@ import click
 @click.option("--learning-rate", type=float, default=5e-4, help="Base LR (×bs/512).")
 @click.option("--weight-decay", type=float, default=0.05)
 @click.option("--label-smoothing", type=float, default=0.1)
+@click.option(
+    "--ema-decay", type=float, default=None,
+    help="Parameter EMA decay (e.g. 0.9999); eval then runs on the "
+    "averaged weights (DeiT/CaiT-recipe standard).",
+)
 @click.option("--clip-grad", type=float, default=1.0)
 @click.option("--grad-accum", type=int, default=1,
               help="Micro-batches per optimizer update.")
@@ -134,7 +139,8 @@ import click
 def main(
     ctx, data_dir, fake_data, model_name, num_classes, image_size, batch_size,
     num_epochs, warmup_epochs, learning_rate, weight_decay, label_smoothing,
-    clip_grad, grad_accum, augmentation, patch_size, backend, logits_dtype,
+    ema_decay, clip_grad, grad_accum, augmentation, patch_size, backend,
+    logits_dtype,
     remat, dtype, tp, fsdp, sp, sp_method, preset, checkpoint_dir, init_from,
     eval_only, steps, num_train_images,
     num_eval_images, crop_min_area, train_flip, platform, fused_optimizer,
@@ -193,6 +199,7 @@ def main(
         base_lr=learning_rate,
         weight_decay=weight_decay,
         label_smoothing=label_smoothing,
+        ema_decay=ema_decay,
         clip_grad_norm=clip_grad,
         grad_accum_steps=grad_accum,
         fused_optimizer=fused_optimizer,

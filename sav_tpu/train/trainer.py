@@ -266,12 +266,16 @@ class Trainer:
         # weights: tx.init built it from the random init, and eval-on-EMA
         # would otherwise spend ~1/(1-decay) steps converging back from
         # garbage on exactly the short finetunes EMA is meant to help.
+        # jnp.array(copy=True): the EMA leaf must be a DISTINCT buffer — a
+        # no-copy device_put of the (already-f32, already-placed) param
+        # leaf would alias it, and the donated train step then donates the
+        # same buffer twice (runtime crash on the first finetune step).
         opt_state = jax.tree_util.tree_map(
             lambda s: (
                 EmaState(
                     ema=jax.tree.map(
                         lambda e, p: jax.device_put(
-                            jnp.asarray(p, e.dtype), e.sharding
+                            jnp.array(p, dtype=e.dtype, copy=True), e.sharding
                         ),
                         s.ema,
                         params,

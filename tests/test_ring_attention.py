@@ -146,3 +146,4 @@ def test_ring_flash_rejects_unknown_backend(devices):
     q, k, v = _qkv()
     with pytest.raises(ValueError, match="backend"):
         ring_attention(q, k, v, mesh=mesh, backend="cuda")
+

@@ -531,6 +531,14 @@ def test_warm_start_cross_resolution(tmp_path, devices):
         jax.device_get(ema["head"]["kernel"]),
         jax.device_get(state.params["head"]["kernel"]),
     )
+    # ...and as a distinct buffer: the donated train step would otherwise
+    # donate the aliased params/EMA buffer twice (runtime crash).
+    batch48 = {
+        "images": np.zeros((16, 48, 48, 3), np.float32),
+        "labels": np.arange(16) % 10,
+    }
+    warm, metrics = fine.train_step(warm, batch48, jax.random.PRNGKey(1))
+    assert np.isfinite(float(jax.device_get(metrics["loss"])))
 
 
 def test_ema_tracks_post_step_params(devices):

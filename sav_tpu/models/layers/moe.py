@@ -43,6 +43,14 @@ class MoEFFBlock(nn.Module):
     expand_ratio: Optional[float] = 4.0
     hidden_ch: Optional[int] = None
     dropout_rate: float = 0.0
+    # Router z-loss (ST-MoE): mean(logsumexp(router logits)²), sown
+    # alongside the balance loss. Keeps router logits from drifting to
+    # magnitudes where the fp32 softmax saturates and routing gradients
+    # vanish. Every sown loss is scaled by TrainConfig.aux_loss_weight
+    # (0.01 default) in the trainer, so the default here (0.1) makes the
+    # EFFECTIVE coefficient 0.1 x 0.01 = 1e-3 — the ST-MoE paper value.
+    # 0 disables (and keeps the sown-losses set of older configs).
+    router_z_loss_weight: float = 0.1
     activation_fn: Callable = nn.gelu
     dtype: Dtype = jnp.float32
 
@@ -73,9 +81,21 @@ class MoEFFBlock(nn.Module):
         # E · Σ_e f_e · P_e where f_e = fraction of tokens whose top-1 choice
         # is e, P_e = mean router probability for e. Minimized (=1) by a
         # uniform router.
+        # Sown-loss convention: every 'losses' entry is a ready-to-sum
+        # penalty at its RELATIVE scale — balance at coefficient 1, z-loss
+        # pre-multiplied by router_z_loss_weight — and the trainer's single
+        # aux_loss_weight converts relative units to loss units for the
+        # whole collection (trainer.py loss_fn).
         top1_frac = jnp.mean(jax.nn.one_hot(expert_idx[..., 0], n_exp), axis=(0, 1))
         aux_loss = n_exp * jnp.sum(top1_frac * jnp.mean(probs, axis=(0, 1)))
         self.sow("losses", "moe_aux_loss", aux_loss)
+        if self.router_z_loss_weight:
+            z = jax.nn.logsumexp(logits, axis=-1)  # [G, S]
+            self.sow(
+                "losses",
+                "moe_router_z_loss",
+                self.router_z_loss_weight * jnp.mean(z * z),
+            )
 
         # --- Capacity-based dispatch/combine, GShard-style grouped --------
         # Capacity is per *group* (each batch row routes independently), so

@@ -34,6 +34,7 @@ class EncoderBlock(nn.Module):
     dropout_rate: float = 0.0
     moe_num_experts: Optional[int] = None  # MoE FF instead of dense FF
     moe_top_k: int = 2
+    moe_router_z_loss_weight: float = 0.1  # see MoEFFBlock; 0 disables
     use_rotary: bool = False
     backend: Optional[str] = None
     logits_dtype: Optional[Dtype] = None  # None = inherit dtype (softmax math)
@@ -61,6 +62,7 @@ class EncoderBlock(nn.Module):
             y = MoEFFBlock(
                 num_experts=self.moe_num_experts,
                 top_k=self.moe_top_k,
+                router_z_loss_weight=self.moe_router_z_loss_weight,
                 expand_ratio=self.expand_ratio,
                 dropout_rate=self.dropout_rate,
                 dtype=self.dtype,
@@ -84,6 +86,7 @@ class Encoder(nn.Module):
     dropout_rate: float = 0.0
     moe_num_experts: Optional[int] = None
     moe_top_k: int = 2
+    moe_router_z_loss_weight: float = 0.1  # see MoEFFBlock; 0 disables
     moe_every: int = 2  # MoE FF on every moe_every-th block (GShard-style)
     # 'learned' (reference vit.py:46), 'sincos', 'rotary' (RoPE on Q/K in
     # every block), or 'none'.
@@ -128,6 +131,7 @@ class Encoder(nn.Module):
                 dropout_rate=self.dropout_rate,
                 moe_num_experts=self.moe_num_experts if is_moe else None,
                 moe_top_k=self.moe_top_k,
+                moe_router_z_loss_weight=self.moe_router_z_loss_weight,
                 use_rotary=self.pos_embed == "rotary",
                 backend=self.backend,
                 logits_dtype=self.logits_dtype,
@@ -152,6 +156,7 @@ class ViT(nn.Module):
     dropout_rate: float = 0.0
     moe_num_experts: Optional[int] = None
     moe_top_k: int = 2
+    moe_router_z_loss_weight: float = 0.1  # see MoEFFBlock; 0 disables
     moe_every: int = 2
     pos_embed: str = "learned"
     remat: bool = False  # see Encoder.remat
@@ -178,6 +183,7 @@ class ViT(nn.Module):
             dropout_rate=self.dropout_rate,
             moe_num_experts=self.moe_num_experts,
             moe_top_k=self.moe_top_k,
+            moe_router_z_loss_weight=self.moe_router_z_loss_weight,
             moe_every=self.moe_every,
             pos_embed=self.pos_embed,
             remat=self.remat,

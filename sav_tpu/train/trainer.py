@@ -420,6 +420,10 @@ class Trainer:
                 mutable=mutable,
             )
             new_batch_stats = new_vars["batch_stats"] if has_bn else batch_stats
+            # Sown 'losses' are ready-to-sum penalties at their relative
+            # scales (see MoEFFBlock's convention note); aux_loss_weight is
+            # the single relative→loss-units conversion, and the logged
+            # aux_loss metric is the relative-units sum.
             aux = sum(
                 jnp.sum(leaf)
                 for leaf in jax.tree.leaves(new_vars.get("losses", {}))

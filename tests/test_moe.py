@@ -141,3 +141,31 @@ def test_moe_trainer_step_includes_aux_loss(devices):
     assert np.isfinite(float(jax.device_get(metrics["loss"])))
     aux = float(jax.device_get(metrics["aux_loss"]))
     assert np.isfinite(aux) and aux >= 0.5
+
+
+def test_router_z_loss_sown_and_penalizes_magnitude():
+    """z-loss = weight · mean(logsumexp(logits)²): present in the sown
+    losses, zero when disabled, and larger for a router pushed to bigger
+    logit magnitudes (the drift it exists to penalize)."""
+    x = jax.random.normal(jax.random.PRNGKey(0), (2, 8, 16), jnp.float32)
+    block = MoEFFBlock(num_experts=4, top_k=2, hidden_ch=32)
+    init_vars = block.init({"params": jax.random.PRNGKey(1)}, x, False)
+    # init itself sows into a 'losses' collection — keep params only, or
+    # the stale entries ride into every apply's output state.
+    variables = {"params": init_vars["params"]}
+    _, state = block.apply(variables, x, False, mutable=["losses"])
+    losses = state["losses"]
+    assert "moe_router_z_loss" in losses
+    z = float(losses["moe_router_z_loss"][0])
+    assert z > 0.0
+
+    # Scaling the router weights up increases logit magnitudes -> larger z.
+    big = {"params": dict(variables["params"])}
+    big["params"]["router"] = variables["params"]["router"] * 16.0
+    _, state_big = block.apply(big, x, False, mutable=["losses"])
+    assert float(state_big["losses"]["moe_router_z_loss"][0]) > z
+
+    off = MoEFFBlock(num_experts=4, top_k=2, hidden_ch=32,
+                     router_z_loss_weight=0.0)
+    _, state_off = off.apply(variables, x, False, mutable=["losses"])
+    assert "moe_router_z_loss" not in state_off["losses"]

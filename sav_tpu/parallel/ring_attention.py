@@ -95,9 +95,11 @@ def _ring_shard_fn(q, k, v, *, axis_name: str, axis_size: int, scale: float,
             if masked:
                 origin = jax.lax.ppermute(origin, axis_name, perm)
     if masked:
-        # Padded query rows have l == 0 (every key masked); emit zeros, not
-        # 0/0 — the caller slices them off, but NaNs would poison any
-        # reduction run over the raw output.
+        # Defensive NaN guard. Masking is key-side only, so every query row
+        # (padded or not) always attends to >= 1 valid key and l > 0 holds —
+        # this branch should be unreachable. Kept so that a future mask
+        # variant that can zero a full row degrades to zeros, not 0/0 NaNs
+        # that would poison reductions run over the raw output.
         l = jnp.where(l == 0.0, 1.0, l)
     out = acc / jnp.transpose(l, (0, 2, 1, 3))
     return out.astype(q.dtype)

@@ -22,6 +22,7 @@ not apply under SP — see ``TrainConfig.sequence_parallel``.
 from __future__ import annotations
 
 import functools
+import warnings
 from typing import Optional
 
 import jax
@@ -85,6 +86,19 @@ def sequence_parallel_attention(
         # holds the full batch, which is what small interactive calls and
         # single-example debugging want).
         batch_axis = axes if axes and b % group == 0 else None
+        if batch_axis is None and axes and group > 1:
+            # Replication is correct but multiplies per-device attention
+            # memory/compute by the data-axis product — fine for debugging,
+            # a silent footgun at training scale. Fires at trace time only;
+            # warnings' default filter dedups repeats of the same (b, group)
+            # message, so steady-state training logs one line per shape.
+            warnings.warn(
+                f"sequence_parallel_attention: batch {b} does not divide the "
+                f"mesh's data-axis product {group}; replicating the batch "
+                "across all sequence-group members. Size the global batch as "
+                "a multiple of the data axes for training-scale calls.",
+                stacklevel=2,
+            )
     if method == "ulysses" and heads % n:
         raise ValueError(
             f"ulysses needs head count ({heads}) divisible by the "

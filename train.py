@@ -174,7 +174,14 @@ def main(
 
     mesh_axes = None
     if tp > 1 or fsdp > 1 or sp > 1:
-        mesh_axes = {"data": n_devices // (tp * fsdp * sp)}
+        parallel = tp * fsdp * sp
+        if parallel > n_devices or n_devices % parallel:
+            raise click.UsageError(
+                f"--tp {tp} x --fsdp {fsdp} x --sp {sp} = {parallel} must "
+                f"divide the device count ({n_devices}); the quotient is the "
+                "data-parallel axis and must be >= 1"
+            )
+        mesh_axes = {"data": n_devices // parallel}
         if fsdp > 1:
             mesh_axes["fsdp"] = fsdp
         if tp > 1:

@@ -146,6 +146,14 @@ def pipeline(
       ``[B, ...]`` outputs, replicated over ``pipe_axis``.
     """
     num_stages = mesh.shape[pipe_axis]
+    batch_shards = mesh.shape[batch_axis] if batch_axis else 1
+    local_b = x.shape[0] // batch_shards
+    if local_b % num_microbatches:
+        raise ValueError(
+            f"per-shard batch {local_b} (global {x.shape[0]} over "
+            f"{batch_shards} '{batch_axis}' shards) must be divisible by "
+            f"num_microbatches={num_microbatches}"
+        )
     for path, leaf in jax.tree_util.tree_flatten_with_path(stacked_params)[0]:
         if leaf.shape[0] != num_stages:
             name = "/".join(str(k) for k in path)

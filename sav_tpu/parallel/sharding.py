@@ -25,7 +25,12 @@ from typing import Any
 import jax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from sav_tpu.parallel.mesh import EXPERT_AXIS, FSDP_AXIS, MODEL_AXIS
+from sav_tpu.parallel.mesh import (
+    EXPERT_AXIS,
+    FSDP_AXIS,
+    MODEL_AXIS,
+    PIPE_AXIS,
+)
 
 # (path regex, partition spec builder taking the param ndim)
 DEFAULT_TP_RULES: list[tuple[str, Any]] = [
@@ -47,6 +52,15 @@ DEFAULT_TP_RULES: list[tuple[str, Any]] = [
 DEFAULT_EP_RULES: list[tuple[str, Any]] = [
     (r"experts_(w1|w2)$", P(EXPERT_AXIS, None, None)),
     (r"experts_(b1|b2)$", P(EXPERT_AXIS, None)),
+]
+
+# Pipeline parallelism: every leaf of a PipelinedViT's 'pipe_stages' subtree
+# carries a leading [S, ...] stage axis — shard it over 'pipe' so stage i's
+# weights live only on pipe slice i (sav_tpu/models/pipelined.py). Matched
+# FIRST so the stage-axis placement wins over any suffix rule that would
+# otherwise hit the same leaf.
+DEFAULT_PP_RULES: list[tuple[str, Any]] = [
+    (r"pipe_stages/", P(PIPE_AXIS)),
 ]
 
 
@@ -117,6 +131,8 @@ def param_shardings(
     """
     if rules is None:
         rules = []
+        if PIPE_AXIS in mesh.axis_names:
+            rules = rules + DEFAULT_PP_RULES
         if EXPERT_AXIS in mesh.axis_names:
             rules = rules + DEFAULT_EP_RULES
         if MODEL_AXIS in mesh.axis_names:

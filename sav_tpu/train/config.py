@@ -93,6 +93,15 @@ class TrainConfig:
     # core is dense XLA (attention_backend='pallas' is rejected; the bare
     # parallel.ring_attention op exposes flash mode for divisible lengths).
     sequence_parallel: Optional[str] = None
+    # Pipeline parallelism: S > 1 pipelines the encoder stack of a
+    # ViT-family model over the mesh's 'pipe' axis (GPipe microbatch
+    # schedule, sav_tpu/models/pipelined.py; train.py --pp S builds the
+    # mesh). The per-data-shard batch (global_batch_size / grad_accum_steps
+    # / data-axis-size) must be divisible by pipeline_microbatches; bubble
+    # fraction is (S-1)/(M+S-1). ViT family only; MoE and stage dropout
+    # are rejected at construction.
+    pipeline_parallel: Optional[int] = None
+    pipeline_microbatches: int = 8
 
     # Logging / checkpointing
     eval_every_epochs: int = 5

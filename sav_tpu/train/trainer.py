@@ -87,22 +87,44 @@ class Trainer:
                     f"'{SEQ_AXIS}' mesh axis; got {self.mesh.axis_names} "
                     "(set mesh_axes={'data': -1, 'seq': N} or train.py --sp N)"
                 )
-        self.model = (
-            model
-            if model is not None
-            else create_model(
+        pp = config.pipeline_parallel
+        if pp is not None and pp > 1 and model is None:
+            if config.sequence_parallel:
+                raise ValueError(
+                    "pipeline_parallel does not compose with "
+                    "sequence_parallel (the pipelined stages run the dense "
+                    "attention core); pick one"
+                )
+            from sav_tpu.models.pipelined import create_pipelined_model
+
+            self.model = create_pipelined_model(
                 config.model_name,
+                num_stages=pp,
+                num_microbatches=config.pipeline_microbatches,
+                mesh=self.mesh,
                 num_classes=config.num_classes,
                 dtype=self.compute_dtype,
                 backend=config.attention_backend,
                 logits_dtype=config.attention_logits_dtype,
-                # SP threads the trainer's mesh into every attention block
-                # (the blocks shard_map their q/k/v over its 'seq' axis).
-                seq_parallel=config.sequence_parallel,
-                seq_mesh=self.mesh if config.sequence_parallel else None,
                 **(config.model_overrides or {}),
             )
-        )
+        else:
+            self.model = (
+                model
+                if model is not None
+                else create_model(
+                    config.model_name,
+                    num_classes=config.num_classes,
+                    dtype=self.compute_dtype,
+                    backend=config.attention_backend,
+                    logits_dtype=config.attention_logits_dtype,
+                    # SP threads the trainer's mesh into every attention
+                    # block (the blocks shard_map q/k/v over its 'seq' axis).
+                    seq_parallel=config.sequence_parallel,
+                    seq_mesh=self.mesh if config.sequence_parallel else None,
+                    **(config.model_overrides or {}),
+                )
+            )
         if model is not None:
             # These config fields are model *attributes* now; an external
             # model carries its own. Silent divergence would train with
@@ -129,6 +151,15 @@ class Trainer:
                     "but the externally built model does not carry it; pass "
                     "create_model(..., seq_parallel=..., seq_mesh=...) to "
                     "match, or leave the config field None"
+                )
+            if (config.pipeline_parallel or 1) > 1 and (
+                getattr(model, "num_stages", None) != config.pipeline_parallel
+            ):
+                raise ValueError(
+                    f"config.pipeline_parallel={config.pipeline_parallel} "
+                    "but the externally built model is not a pipelined model "
+                    "with that stage count; build it via "
+                    "create_pipelined_model(...) or leave the field None"
                 )
         self.schedule = warmup_cosine_schedule(
             config.learning_rate,

@@ -79,6 +79,18 @@ import click
     "'ulysses' uses two all-to-alls (needs heads % sp == 0).",
 )
 @click.option(
+    "--pp", type=int, default=1,
+    help="Pipeline-parallel stage count: a ViT-family encoder stack is "
+    "split into S stages over a 'pipe' mesh axis and run on the GPipe "
+    "microbatch schedule (sav_tpu/models/pipelined.py). Composes with "
+    "data parallelism; not with --tp/--fsdp/--sp.",
+)
+@click.option(
+    "--pp-microbatches", type=int, default=8,
+    help="GPipe microbatch count M (bubble fraction (S-1)/(M+S-1)); the "
+    "per-data-shard batch must be divisible by it.",
+)
+@click.option(
     "--preset", type=str, default=None,
     help="Named experiment preset (sav_tpu.train.presets); CLI flags override.",
 )
@@ -141,7 +153,8 @@ def main(
     num_epochs, warmup_epochs, learning_rate, weight_decay, label_smoothing,
     ema_decay, clip_grad, grad_accum, augmentation, patch_size, backend,
     logits_dtype,
-    remat, dtype, tp, fsdp, sp, sp_method, preset, checkpoint_dir, init_from,
+    remat, dtype, tp, fsdp, sp, sp_method, pp, pp_microbatches, preset,
+    checkpoint_dir, init_from,
     eval_only, steps, num_train_images,
     num_eval_images, crop_min_area, train_flip, platform, fused_optimizer,
     device_preprocess, seed,
@@ -173,13 +186,17 @@ def main(
     from sav_tpu.data.pipeline import Split, load
 
     mesh_axes = None
-    if tp > 1 or fsdp > 1 or sp > 1:
-        parallel = tp * fsdp * sp
+    if pp > 1 and (tp > 1 or fsdp > 1 or sp > 1):
+        raise click.UsageError(
+            "--pp composes with data parallelism only; drop --tp/--fsdp/--sp"
+        )
+    if tp > 1 or fsdp > 1 or sp > 1 or pp > 1:
+        parallel = tp * fsdp * sp * pp
         if parallel > n_devices or n_devices % parallel:
             raise click.UsageError(
-                f"--tp {tp} x --fsdp {fsdp} x --sp {sp} = {parallel} must "
-                f"divide the device count ({n_devices}); the quotient is the "
-                "data-parallel axis and must be >= 1"
+                f"--tp {tp} x --fsdp {fsdp} x --sp {sp} x --pp {pp} = "
+                f"{parallel} must divide the device count ({n_devices}); "
+                "the quotient is the data-parallel axis and must be >= 1"
             )
         mesh_axes = {"data": n_devices // parallel}
         if fsdp > 1:
@@ -188,6 +205,18 @@ def main(
             mesh_axes["model"] = tp
         if sp > 1:
             mesh_axes["seq"] = sp
+        if pp > 1:
+            mesh_axes["pipe"] = pp
+            # grad accumulation splits the step's batch BEFORE it reaches
+            # the pipeline, so the microbatch constraint applies per chunk.
+            per_shard = batch_size // grad_accum // mesh_axes["data"]
+            if batch_size % grad_accum or per_shard % pp_microbatches:
+                raise click.UsageError(
+                    f"per-data-shard batch {per_shard} (global {batch_size}"
+                    f"{f' / grad-accum {grad_accum}' if grad_accum > 1 else ''}"
+                    f" over {mesh_axes['data']} data shards) must be "
+                    f"divisible by --pp-microbatches {pp_microbatches}"
+                )
 
     config = TrainConfig(
         model_name=model_name,
@@ -213,6 +242,8 @@ def main(
         device_preprocess=device_preprocess,
         mesh_axes=mesh_axes,
         sequence_parallel=sp_method if sp > 1 else None,
+        pipeline_parallel=pp if pp > 1 else None,
+        pipeline_microbatches=pp_microbatches,
         checkpoint_dir=checkpoint_dir,
         seed=seed,
         **(
@@ -254,6 +285,9 @@ def main(
             overrides["mesh_axes"] = mesh_axes
         if sp > 1:
             overrides["sequence_parallel"] = sp_method
+        if pp > 1:
+            overrides["pipeline_parallel"] = pp
+            overrides["pipeline_microbatches"] = pp_microbatches
         config = get_preset(preset, **overrides)
         if "remat" in explicit:
             # Merge into the preset's overrides rather than replacing them —

@@ -39,6 +39,8 @@ class EncoderBlock(nn.Module):
     dropout_rate: float = 0.0
     backend: Optional[str] = None
     logits_dtype: Optional[Dtype] = None  # None = inherit dtype (softmax math)
+    seq_parallel: Optional[str] = None  # 'ring' only (talking-heads trunk)
+    seq_mesh: Optional[Any] = None
     dtype: Dtype = jnp.float32
 
     @nn.compact
@@ -51,6 +53,8 @@ class EncoderBlock(nn.Module):
             out_dropout_rate=self.dropout_rate,
             backend=self.backend,
             logits_dtype=self.logits_dtype,
+            seq_parallel=self.seq_parallel,
+            seq_mesh=self.seq_mesh,
             dtype=self.dtype,
         )(x, is_training)
         x = LayerScaleBlock(eps=self.layerscale_eps, dtype=self.dtype)(x)
@@ -122,6 +126,13 @@ class CaiT(nn.Module):
     dropout_rate: float = 0.0
     backend: Optional[str] = None
     logits_dtype: Optional[Dtype] = None  # None = inherit dtype (softmax math)
+    # Sequence parallelism over the SA trunk ('ring' only — the talking-
+    # heads mix rides head-pair accumulators, see parallel.ring_attention).
+    # The class-attention head (single-query CLS over L tokens) stays
+    # unsharded: its logits are [B, H, 1, L] — there is no L x L term to
+    # shard away.
+    seq_parallel: Optional[str] = None
+    seq_mesh: Optional[Any] = None
     dtype: Dtype = jnp.float32
 
     @nn.compact
@@ -141,6 +152,8 @@ class CaiT(nn.Module):
                 dropout_rate=self.dropout_rate,
                 backend=self.backend,
                 logits_dtype=self.logits_dtype,
+                seq_parallel=self.seq_parallel,
+                seq_mesh=self.seq_mesh,
                 dtype=self.dtype,
                 name=f"block_{i}",
             )(x, is_training)

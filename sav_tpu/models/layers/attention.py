@@ -220,11 +220,11 @@ class AttentionBlock(nn.Module):
 
         has_attn_dropout = self.attn_dropout_rate > 0.0 and is_training
         if self.seq_parallel:
-            if self.talking_heads:
+            if self.talking_heads and self.seq_parallel != "ring":
                 raise ValueError(
-                    "sequence parallelism does not compose with talking "
-                    "heads (head mixing couples heads across the sharded "
-                    "softmax); unset one of the two"
+                    "talking-heads sequence parallelism is ring-only "
+                    "(Ulysses shards heads across devices; the head mix "
+                    "would cross them) — use seq_parallel='ring'"
                 )
             if has_attn_dropout:
                 raise ValueError(
@@ -257,6 +257,22 @@ class AttentionBlock(nn.Module):
                 sequence_parallel_attention,
             )
 
+            th = None
+            if self.talking_heads:
+                # Head mixing rides the ring via head-pair accumulators
+                # (parallel.ring_attention._ring_talking_heads_shard_fn);
+                # same {pre,post}_softmax/kernel checkpoint layout as the
+                # dense and fused paths.
+                th = (
+                    TalkingHeadsBlock(
+                        num_heads=self.num_heads, dtype=self.dtype,
+                        name="pre_softmax",
+                    )(None),
+                    TalkingHeadsBlock(
+                        num_heads=self.num_heads, dtype=self.dtype,
+                        name="post_softmax",
+                    )(None),
+                )
             out = sequence_parallel_attention(
                 query,
                 key,
@@ -264,6 +280,7 @@ class AttentionBlock(nn.Module):
                 mesh=self.seq_mesh,
                 method=self.seq_parallel,
                 scale=scale,
+                talking_heads=th,
             )
         elif self.talking_heads:
             from sav_tpu.ops.talking_heads import fused_eligible

@@ -196,9 +196,10 @@ def create_model(
         if "seq_parallel" not in cls.__dataclass_fields__:
             raise ValueError(
                 f"{model_name!r} does not support sequence parallelism "
-                "(SP-capable: ViT/DeiT, TNT outer stream, CeiT trunk; "
-                "CaiT is talking-heads, CvT conv-projected, BoTNet "
-                "2-D-bias — their cores keep the dense path)"
+                "(SP-capable: ViT/DeiT, TNT outer stream, CeiT trunk, "
+                "CaiT trunk (ring-only, talking-heads); CvT's strided conv "
+                "projections and BoTNet's 2-D relative-position bias keep "
+                "the dense path — see docs/parallelism.md)"
             )
         merged["seq_parallel"] = seq_parallel
         merged["seq_mesh"] = seq_mesh

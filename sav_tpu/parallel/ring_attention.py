@@ -38,16 +38,67 @@ _fa = importlib.import_module("sav_tpu.ops.flash_attention")
 _NEG_INF = float("-inf")
 
 
+def _mask_key_block(s, origin, blk_len: int, valid_len: int):
+    """Force logits at global key positions ``>= valid_len`` to −inf.
+
+    Each K/V block travels with its origin shard index (rotated along with
+    the block) so global positions stay recoverable after any number of
+    ppermutes."""
+    key_pos = origin * blk_len + jax.lax.iota(jnp.int32, blk_len)
+    return jnp.where(key_pos[None, None, None, :] < valid_len, s, _NEG_INF)
+
+
+def _online_softmax_update(m, l, s, masked: bool):
+    """One block's contribution to the running (max, denominator).
+
+    Returns ``(m_new, l_new, alpha, p)``: the updated statistics, the
+    rescale factor for existing accumulators, and the block's unnormalized
+    probabilities — the same (m, l, acc) algebra the flash kernel uses."""
+    m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
+    if masked:
+        # A fully-masked block leaves m at -inf; exp(-inf - -inf) = nan,
+        # so guard the shift (the block contributes exactly zero mass).
+        m_safe = jnp.where(jnp.isneginf(m_new), 0.0, m_new)
+        alpha = jnp.exp(jnp.where(jnp.isneginf(m), _NEG_INF, m - m_safe))
+        p = jnp.exp(s - m_safe)
+    else:
+        alpha = jnp.exp(m - m_new)
+        p = jnp.exp(s - m_new)
+    return m_new, alpha * l + jnp.sum(p, axis=-1, keepdims=True), alpha, p
+
+
+def _ring_loop(k, v, origin, state, block_fn, *, axis_name: str,
+               axis_size: int):
+    """Rotate K/V (and the origin index, when masking) around the ring,
+    folding each block into ``state`` via ``block_fn(state, k, v, origin)``."""
+    perm = [(j, (j + 1) % axis_size) for j in range(axis_size)]
+    for step in range(axis_size):
+        state = block_fn(state, k, v, origin)
+        if step + 1 < axis_size:
+            k = jax.lax.ppermute(k, axis_name, perm)
+            v = jax.lax.ppermute(v, axis_name, perm)
+            if origin is not None:
+                origin = jax.lax.ppermute(origin, axis_name, perm)
+    return state
+
+
+def _guard_zero_denominator(l):
+    # Defensive NaN guard. Masking is key-side only, so every query row
+    # (padded or not) always attends to >= 1 valid key and l > 0 holds —
+    # this should be unreachable. Kept so that a future mask variant that
+    # can zero a full row degrades to zeros, not 0/0 NaNs that would
+    # poison reductions run over the raw output.
+    return jnp.where(l == 0.0, 1.0, l)
+
+
 def _ring_shard_fn(q, k, v, *, axis_name: str, axis_size: int, scale: float,
                    valid_len: Optional[int] = None):
     """Per-shard body. q/k/v: ``[B, L_loc, H, D]`` (local shards).
 
     ``valid_len`` (static) masks global key positions ``>= valid_len`` out
     of every softmax — the pad-and-mask path :mod:`sav_tpu.parallel.seq_parallel`
-    uses for CLS-odd model sequence lengths. Each K/V block then travels
-    with its origin shard index (rotated along with the block) so global
-    positions stay recoverable after any number of ppermutes. ``None``
-    compiles to the unmasked loop (no extra ops).
+    uses for CLS-odd model sequence lengths. ``None`` compiles to the
+    unmasked loop (no extra ops).
     """
     batch, q_len, heads, dim = q.shape
     m = jnp.full((batch, heads, q_len, 1), _NEG_INF, jnp.float32)
@@ -56,28 +107,14 @@ def _ring_shard_fn(q, k, v, *, axis_name: str, axis_size: int, scale: float,
     masked = valid_len is not None
     origin = jax.lax.axis_index(axis_name) if masked else None
 
-    def one_block(m, l, acc, k_blk, v_blk, origin):
+    def one_block(state, k_blk, v_blk, origin):
+        m, l, acc = state
         s = jnp.einsum(
             "bqhd,bkhd->bhqk", q, k_blk, preferred_element_type=jnp.float32
         ) * scale
         if masked:
-            key_pos = origin * k_blk.shape[1] + jax.lax.iota(
-                jnp.int32, k_blk.shape[1]
-            )
-            s = jnp.where(
-                key_pos[None, None, None, :] < valid_len, s, _NEG_INF
-            )
-        m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
-        if masked:
-            # A fully-masked block leaves m at -inf; exp(-inf - -inf) = nan,
-            # so guard the shift (the block contributes exactly zero mass).
-            m_safe = jnp.where(jnp.isneginf(m_new), 0.0, m_new)
-            alpha = jnp.exp(jnp.where(jnp.isneginf(m), _NEG_INF, m - m_safe))
-            p = jnp.exp(s - m_safe)
-        else:
-            alpha = jnp.exp(m - m_new)
-            p = jnp.exp(s - m_new)
-        l_new = alpha * l + jnp.sum(p, axis=-1, keepdims=True)
+            s = _mask_key_block(s, origin, k_blk.shape[1], valid_len)
+        m_new, l_new, alpha, p = _online_softmax_update(m, l, s, masked)
         pv = jnp.einsum(
             "bhqk,bkhd->bqhd", p.astype(v_blk.dtype), v_blk,
             preferred_element_type=jnp.float32,
@@ -86,22 +123,76 @@ def _ring_shard_fn(q, k, v, *, axis_name: str, axis_size: int, scale: float,
         alpha_q = jnp.transpose(alpha, (0, 2, 1, 3))
         return m_new, l_new, acc * alpha_q + pv
 
-    perm = [(j, (j + 1) % axis_size) for j in range(axis_size)]
-    for step in range(axis_size):
-        m, l, acc = one_block(m, l, acc, k, v, origin)
-        if step + 1 < axis_size:
-            k = jax.lax.ppermute(k, axis_name, perm)
-            v = jax.lax.ppermute(v, axis_name, perm)
-            if masked:
-                origin = jax.lax.ppermute(origin, axis_name, perm)
+    m, l, acc = _ring_loop(
+        k, v, origin, (m, l, acc), one_block,
+        axis_name=axis_name, axis_size=axis_size,
+    )
     if masked:
-        # Defensive NaN guard. Masking is key-side only, so every query row
-        # (padded or not) always attends to >= 1 valid key and l > 0 holds —
-        # this branch should be unreachable. Kept so that a future mask
-        # variant that can zero a full row degrades to zeros, not 0/0 NaNs
-        # that would poison reductions run over the raw output.
-        l = jnp.where(l == 0.0, 1.0, l)
+        l = _guard_zero_denominator(l)
     out = acc / jnp.transpose(l, (0, 2, 1, 3))
+    return out.astype(q.dtype)
+
+
+def _ring_talking_heads_shard_fn(
+    q, k, v, w_pre, w_post, *, axis_name: str, axis_size: int, scale: float,
+    valid_len: Optional[int] = None,
+):
+    """Ring attention with CaiT's pre/post-softmax head mixing — exact, one
+    rotation (the seam that unlocks SP for talking-heads trunks).
+
+    Head mixing couples heads across the softmax, which breaks the per-head
+    online accumulator of :func:`_ring_shard_fn`: the post-mix probability
+    ``pm_j = Σ_i Wpost[i,j] p_i`` pairs source-head-``i`` probabilities with
+    head-``j`` *values*, so the output does not decompose into per-head
+    attention outputs. It does decompose into head-*pair* accumulators::
+
+        out[q,j] = Σ_i Wpost[i,j] · (Σ_k p_i,qk · v_k,j) / l_i,q
+                 = Σ_i Wpost[i,j] · A[i,j,q] / l_i,q
+
+    where ``A[i,j] = Σ_k exp(s̃_i,qk − m_i,q) v_k,j`` accumulates online
+    with source-head-``i`` statistics (running max ``m_i``, denominator
+    ``l_i``) exactly like flash — per-device memory is O(H²·L_loc·D), still
+    no L² term, at H× the PV FLOPs (H is 4-16 for the model zoo). The
+    pre-softmax mix ``s̃ = Wpreᵀ s`` is block-local and rides unchanged;
+    key-side masking applies after it (padded columns forced to −inf, so
+    they carry zero mass regardless of what the mix wrote there).
+    """
+    batch, q_len, heads, dim = q.shape
+    m = jnp.full((batch, heads, q_len, 1), _NEG_INF, jnp.float32)
+    l = jnp.zeros((batch, heads, q_len, 1), jnp.float32)
+    # Head-pair accumulator: [B, src_head i, val_head j, Lq, D].
+    acc = jnp.zeros((batch, heads, heads, q_len, dim), jnp.float32)
+    masked = valid_len is not None
+    origin = jax.lax.axis_index(axis_name) if masked else None
+    w_pre32 = w_pre.astype(jnp.float32)
+
+    def one_block(state, k_blk, v_blk, origin):
+        m, l, acc = state
+        s = jnp.einsum(
+            "bqhd,bkhd->bhqk", q, k_blk, preferred_element_type=jnp.float32
+        ) * scale
+        # Pre-softmax mix (TalkingHeadsBlock convention: out_i = Σ_h W[h,i] x_h).
+        s = jnp.einsum("hi,bhqk->biqk", w_pre32, s)
+        if masked:
+            s = _mask_key_block(s, origin, k_blk.shape[1], valid_len)
+        m_new, l_new, alpha, p = _online_softmax_update(m, l, s, masked)
+        # [B,i,Lq,K] × [B,K,j,D] → [B,i,j,Lq,D]
+        pv = jnp.einsum(
+            "biqk,bkjd->bijqd", p, v_blk.astype(jnp.float32),
+            preferred_element_type=jnp.float32,
+        )
+        # alpha: [B,i,Lq,1] → broadcast over (j, D) in [B,i,j,Lq,D].
+        return m_new, l_new, acc * alpha[:, :, None, :, :] + pv
+
+    m, l, acc = _ring_loop(
+        k, v, origin, (m, l, acc), one_block,
+        axis_name=axis_name, axis_size=axis_size,
+    )
+    if masked:
+        l = _guard_zero_denominator(l)
+    # out[b,q,j,d] = Σ_i Wpost[i,j] · acc[b,i,j,q,d] / l[b,i,q]
+    normed = acc / l[:, :, None, :, :]
+    out = jnp.einsum("ij,bijqd->bqjd", w_post.astype(jnp.float32), normed)
     return out.astype(q.dtype)
 
 

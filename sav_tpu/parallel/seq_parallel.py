@@ -32,7 +32,10 @@ from jax.sharding import Mesh, PartitionSpec as P
 
 from sav_tpu.parallel._compat import shard_map
 from sav_tpu.parallel.mesh import SEQ_AXIS, batch_axes
-from sav_tpu.parallel.ring_attention import _ring_shard_fn
+from sav_tpu.parallel.ring_attention import (
+    _ring_shard_fn,
+    _ring_talking_heads_shard_fn,
+)
 from sav_tpu.parallel.ulysses import _ulysses_shard_fn
 
 METHODS = ("ring", "ulysses")
@@ -48,6 +51,7 @@ def sequence_parallel_attention(
     seq_axis: str = SEQ_AXIS,
     batch_axis=None,
     scale: Optional[float] = None,
+    talking_heads: Optional[tuple] = None,
 ) -> jax.Array:
     """Exact SP attention for arbitrary (CLS-token-odd) sequence lengths.
 
@@ -61,6 +65,12 @@ def sequence_parallel_attention(
       batch_axis: mesh axes the batch dim shards over; default: the mesh's
         batch axes when the batch divides them, else replicated.
       scale: logits scale, default ``D ** -0.5``.
+      talking_heads: optional ``(w_pre, w_post)`` pair of ``[H, H]`` head-
+        mixing matrices (CaiT trunk). Ring only: the mixing couples heads
+        across the softmax, handled exactly by head-pair accumulators
+        (:func:`sav_tpu.parallel.ring_attention._ring_talking_heads_shard_fn`);
+        Ulysses scatters heads across devices, which the mix would have to
+        cross — rejected.
 
     Returns:
       ``[B, L, H, D]`` like the inputs.
@@ -68,6 +78,11 @@ def sequence_parallel_attention(
     if method not in METHODS:
         raise ValueError(
             f"unknown sequence-parallel method {method!r}; choose from {METHODS}"
+        )
+    if talking_heads is not None and method != "ring":
+        raise ValueError(
+            "talking-heads sequence parallelism is ring-only (Ulysses "
+            "shards heads across devices; the head mix would cross them)"
         )
     if query.shape != key.shape or key.shape != value.shape:
         raise ValueError(
@@ -115,6 +130,26 @@ def sequence_parallel_attention(
     valid_len = length if pad else None
 
     spec = P(batch_axis, seq_axis, None, None)
+    if talking_heads is not None:
+        w_pre, w_post = talking_heads
+        rep = P()  # [H, H] mixing matrices replicate across the mesh
+        shard_fn = functools.partial(
+            _ring_talking_heads_shard_fn,
+            axis_name=seq_axis,
+            axis_size=n,
+            scale=float(scale),
+            valid_len=valid_len,
+        )
+        out = shard_map(
+            shard_fn,
+            mesh=mesh,
+            in_specs=(spec, spec, spec, rep, rep),
+            out_specs=spec,
+            check_rep=False,
+        )(query, key, value, w_pre, w_post)
+        if pad:
+            out = out[:, :length]
+        return out
     if method == "ring":
         shard_fn = functools.partial(
             _ring_shard_fn,

@@ -22,6 +22,76 @@ def _qkv(b=2, l=17, h=4, d=8, dtype=jnp.float32, seed=0):
     return tuple(jax.random.normal(k, (b, l, h, d), dtype) for k in ks)
 
 
+def _dense_talking_heads(q, k, v, w_pre, w_post, scale=None):
+    """Dense reference for the ring talking-heads path (the math of
+    models.layers.attention.talking_heads_attention, without the modules)."""
+    scale = scale or q.shape[-1] ** -0.5
+    s = jnp.einsum("bqhd,bkhd->bhqk", q * scale, k,
+                   preferred_element_type=jnp.float32)
+    s = jnp.einsum("hi,bhqk->biqk", w_pre.astype(jnp.float32), s)
+    p = jax.nn.softmax(s, axis=-1)
+    p = jnp.einsum("hi,bhqk->biqk", w_post.astype(jnp.float32), p)
+    return jnp.einsum("bhqk,bkhd->bqhd", p.astype(v.dtype), v)
+
+
+@pytest.mark.parametrize("length", [16, 17])  # divisible and CLS-odd (pad)
+def test_ring_talking_heads_matches_dense(devices, length):
+    """The head-pair-accumulator ring equals the dense pre/post-mix core,
+    including the pad-and-mask path."""
+    mesh = create_mesh({"data": 4, "seq": 2})
+    q, k, v = _qkv(l=length)
+    wk = jax.random.split(jax.random.PRNGKey(7), 2)
+    w_pre = jax.random.normal(wk[0], (4, 4), jnp.float32)
+    w_post = jax.random.normal(wk[1], (4, 4), jnp.float32)
+    want = np.asarray(_dense_talking_heads(q, k, v, w_pre, w_post), np.float32)
+    got = np.asarray(
+        sequence_parallel_attention(
+            q, k, v, mesh=mesh, method="ring", talking_heads=(w_pre, w_post)
+        ),
+        np.float32,
+    )
+    np.testing.assert_allclose(got, want, atol=2e-5, rtol=2e-5)
+
+
+@pytest.mark.slow
+def test_ring_talking_heads_grads_match_dense(devices):
+    """Gradients through the ring-TH path — q/k/v AND the mixing matrices
+    (the CaiT trunk trains through this seam)."""
+    mesh = create_mesh({"data": 4, "seq": 2})
+    q, k, v = _qkv(l=17)
+    wk = jax.random.split(jax.random.PRNGKey(8), 2)
+    w_pre = jax.random.normal(wk[0], (4, 4), jnp.float32)
+    w_post = jax.random.normal(wk[1], (4, 4), jnp.float32)
+
+    def dense_loss(q, k, v, wp, wq):
+        return jnp.mean(_dense_talking_heads(q, k, v, wp, wq) ** 2)
+
+    def sp_loss(q, k, v, wp, wq):
+        return jnp.mean(
+            sequence_parallel_attention(
+                q, k, v, mesh=mesh, method="ring", talking_heads=(wp, wq)
+            ) ** 2
+        )
+
+    want = jax.grad(dense_loss, argnums=(0, 1, 2, 3, 4))(q, k, v, w_pre, w_post)
+    got = jax.grad(sp_loss, argnums=(0, 1, 2, 3, 4))(q, k, v, w_pre, w_post)
+    for g, w in zip(got, want):
+        np.testing.assert_allclose(
+            np.asarray(g, np.float32), np.asarray(w, np.float32),
+            atol=5e-5, rtol=5e-5,
+        )
+
+
+def test_talking_heads_rejects_ulysses(devices):
+    mesh = create_mesh({"data": 4, "seq": 2})
+    q, k, v = _qkv()
+    w = jnp.eye(4)
+    with pytest.raises(ValueError, match="ring-only"):
+        sequence_parallel_attention(
+            q, k, v, mesh=mesh, method="ulysses", talking_heads=(w, w)
+        )
+
+
 @pytest.mark.parametrize("method", ["ring", "ulysses"])
 @pytest.mark.parametrize("length", [16, 17])  # divisible and CLS-odd (pad)
 @pytest.mark.slow
@@ -79,6 +149,11 @@ def test_ulysses_rejects_indivisible_heads(devices):
           inner_num_heads=2, patch_shape=(8, 8))),
     # CeiT shards its trunk; the LCA head stays unsharded.
     ("ceit_t", "ring", dict(num_layers=2, embed_dim=64, num_heads=4)),
+    # CaiT shards its talking-heads SA trunk (ring-only, head-pair
+    # accumulators); the class-attention head stays unsharded.
+    ("cait_xxs_24", "ring",
+     dict(num_layers=2, num_layers_token_only=1, embed_dim=64, num_heads=4,
+          patch_shape=(8, 8))),
 ])
 def test_sp_model_forward_matches_unsharded(devices, name, method, kwargs):
     """A 2-way-SP forward equals the plain forward on the same params for

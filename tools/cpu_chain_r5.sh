@@ -1,0 +1,48 @@
+#!/bin/bash
+# Round-5 CPU work chain (1-core host, relay down): waits for the round-4
+# mixer run to finish, then executes the CPU-side VERDICT r4 items:
+#   1. RandAugment-inclusive digits accuracy run (item 5) — the flagship
+#      augment path (mixes AND RA together) trained to a number.
+#   2. ImageNet-shaped dress rehearsal (item 3), CPU-scaled (--batch-size 64)
+#      in TWO segments so the second proves checkpoint restore at the
+#      full-scale configuration.
+# Outputs land in .tpu_results/ (same convention as the chains before it).
+set -u
+cd /root/repo
+mkdir -p .tpu_results .ckpt
+LOG=.tpu_results/cpu_chain_r5_log
+echo "$(date) r5 cpu chain: waiting for mixer run to finish" > "$LOG"
+
+while pgrep -f "preset mixer_digits" >/dev/null 2>&1; do
+  sleep 120
+done
+echo "$(date) mixer done — starting r5 chain" >> "$LOG"
+
+run() {  # run <name> <timeout_s> <cmd...>
+  local name=$1 t=$2; shift 2
+  echo "$(date) START $name" >> "$LOG"
+  timeout "$t" "$@" > ".tpu_results/$name.out" 2>&1
+  local rc=$?
+  echo "$(date) DONE $name (rc=$rc)" >> "$LOG"
+}
+
+# --- 1. RA-inclusive digits run (VERDICT item 5) ----------------------------
+run train_ra_digits_cpu 14400 env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
+  python train.py --preset vit_ti_digits_ra --platform cpu \
+  --data-dir .data/digits --num-train-images 1438 --num-eval-images 359 \
+  --crop-min-area 0.5 --no-train-flip -c .ckpt/ra_digits_cpu --seed 42
+
+# --- 2. Dress rehearsal, CPU-scaled, two segments (VERDICT item 3) ----------
+# Segment 1: 2 epochs (64 steps at bs 64), final checkpoint saved by fit().
+run rehearsal_seg1 10800 env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
+  python train.py --preset deit_s_rehearsal --platform cpu \
+  --data-dir .data/synth_imagenet --num-train-images 2048 --num-eval-images 256 \
+  --batch-size 64 --num-epochs 2 -c .ckpt/rehearsal_cpu
+# Segment 2: 4 epochs — restore_or_init picks up the step-64 checkpoint and
+# continues to 128 (the log's first step proves the restore).
+run rehearsal_seg2 10800 env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
+  python train.py --preset deit_s_rehearsal --platform cpu \
+  --data-dir .data/synth_imagenet --num-train-images 2048 --num-eval-images 256 \
+  --batch-size 64 --num-epochs 4 -c .ckpt/rehearsal_cpu
+
+echo "$(date) r5 cpu chain complete" >> "$LOG"

@@ -84,6 +84,17 @@ done
 # --- 5. Flash long-sequence memory win (VERDICT item 8) ---------------------
 run flash_memwin 2700 env $PP python tools/flash_memory_win.py --ring
 
+# --- 5b. Full-scale dress rehearsal + RA digits on-chip ---------------------
+if [ ! -d .data/synth_imagenet ]; then
+  run make_synth 2700 python tools/make_synth_imagenet.py --out .data/synth_imagenet
+fi
+run tpu_rehearsal 3600 python train.py --preset deit_s_rehearsal \
+  --data-dir .data/synth_imagenet --num-train-images 2048 --num-eval-images 256 \
+  -c .ckpt/rehearsal_tpu
+run tpu_ra_digits 5400 python train.py --preset vit_ti_digits_ra \
+  --data-dir .data/digits --num-train-images 1438 --num-eval-images 359 \
+  --crop-min-area 0.5 --no-train-flip -c .ckpt/tpu_ra_digits --seed 42
+
 # --- 6. Fed benches + profile ----------------------------------------------
 run bench_savrec_host  1500 python bench.py --feed savrec --steps 6
 run bench_savrec_devpp 1500 python bench.py --feed savrec --steps 6 --device-preprocess

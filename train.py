@@ -207,16 +207,8 @@ def main(
             mesh_axes["seq"] = sp
         if pp > 1:
             mesh_axes["pipe"] = pp
-            # grad accumulation splits the step's batch BEFORE it reaches
-            # the pipeline, so the microbatch constraint applies per chunk.
-            per_shard = batch_size // grad_accum // mesh_axes["data"]
-            if batch_size % grad_accum or per_shard % pp_microbatches:
-                raise click.UsageError(
-                    f"per-data-shard batch {per_shard} (global {batch_size}"
-                    f"{f' / grad-accum {grad_accum}' if grad_accum > 1 else ''}"
-                    f" over {mesh_axes['data']} data shards) must be "
-                    f"divisible by --pp-microbatches {pp_microbatches}"
-                )
+            # The batch/microbatch divisibility check runs AFTER preset
+            # resolution below — the preset may change the global batch.
 
     config = TrainConfig(
         model_name=model_name,
@@ -307,6 +299,19 @@ def main(
             raise click.UsageError(
                 f"--remat is only supported by models with a remat field "
                 f"(ViT/DeiT family); {config.model_name!r} has none"
+            )
+    if pp > 1:
+        # Validated against the FINAL config (a preset may change the batch
+        # or grad-accum). Grad accumulation splits the step's batch before
+        # it reaches the pipeline, so the constraint applies per chunk.
+        gbs, accum = config.global_batch_size, config.grad_accum_steps
+        per_shard = gbs // max(accum, 1) // mesh_axes["data"]
+        if gbs % max(accum, 1) or per_shard % pp_microbatches:
+            raise click.UsageError(
+                f"per-data-shard batch {per_shard} (global {gbs}"
+                f"{f' / grad-accum {accum}' if accum > 1 else ''}"
+                f" over {mesh_axes['data']} data shards) must be "
+                f"divisible by --pp-microbatches {pp_microbatches}"
             )
     # Refresh locals the data pipeline uses from the final config.
     model_name = config.model_name

@@ -11,6 +11,14 @@ import os
 os.environ["XLA_FLAGS"] = (
     os.environ.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=8"
 )
+# Defense-in-depth: axon PLUGIN INIT (not just use) dials the relay and blocks
+# while another process holds the chip, so anything that initializes the axon
+# backend here would hang even though tests are CPU-only. The primary guard is
+# the jax_platforms config update below (axon registered but never
+# initialized); dropping the trigger var covers future plugin versions that
+# might init eagerly. For ad-hoc CPU scripts outside pytest, use
+# `env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu python ...`.
+os.environ.pop("PALLAS_AXON_POOL_IPS", None)
 
 import jax
 

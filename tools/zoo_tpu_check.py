@@ -52,7 +52,13 @@ def check(name: str, kwargs: dict, image_size: int, backend: str, batch: int):
         name, num_classes=10, dtype=jnp.bfloat16, backend=backend, **kwargs
     )
     rngs = {"params": jax.random.PRNGKey(0), "dropout": jax.random.PRNGKey(1)}
-    variables = model.init(rngs, x, is_training=False)
+    # Jit the init: eager init dispatches one device op per layer, and each
+    # eager dispatch is a full round-trip through the axon relay — for deep
+    # conv trunks (botnet_t3) that alone took >30 min wall. One traced
+    # compile replaces hundreds of round-trips.
+    variables = dict(
+        jax.jit(lambda r, xx: model.init(r, xx, is_training=False))(rngs, x)
+    )
     params = variables.pop("params")
     # Zero-init heads make fresh logits vacuous; randomize before grads.
     if "head" in params and "kernel" in params["head"]:
@@ -80,10 +86,11 @@ def check(name: str, kwargs: dict, image_size: int, backend: str, batch: int):
     t0 = time.perf_counter()
     loss, grads = jax.jit(jax.value_and_grad(loss_fn))(params)
     loss = float(jax.device_get(loss))
-    finite = all(
-        bool(jax.numpy.all(jax.numpy.isfinite(g.astype(jax.numpy.float32))))
-        for g in jax.tree_util.tree_leaves(grads)
-    )
+    # One fused on-device reduction + one transfer, not one per grad leaf
+    # (each eager leaf check is its own relay round-trip).
+    from sav_tpu.utils.debug import global_norm_nonfinite
+
+    finite = not bool(jax.device_get(jax.jit(global_norm_nonfinite)(grads)))
     dt = time.perf_counter() - t0
     return loss, finite, dt
 

@@ -27,104 +27,15 @@ from __future__ import annotations
 import argparse
 import json
 import statistics
-import subprocess
 import sys
 import time
 
 BASELINE_IMG_PER_SEC_PER_CHIP = 8000.0
 
-# One relay probe, run in a subprocess so a wedged backend init (the tunneled
-# chip can hang rather than error when the relay is down) cannot wedge
-# bench.py itself.  device_get of a computed value, not block_until_ready —
-# the relay can ack early (see docs/benchmarking.md).
-_PROBE_SRC = """
-import jax, jax.numpy as jnp
-print(jax.devices()[0].platform)
-print(jax.device_get((jnp.ones((128, 128), jnp.bfloat16)
-                      @ jnp.ones((128, 128), jnp.bfloat16)).sum()))
-"""
-
-
-def _accelerator_expected():
-    """True when the environment is configured for a non-CPU backend."""
-    import os
-
-    platforms = os.environ.get("JAX_PLATFORMS", "").strip().lower()
-    if platforms and set(platforms.split(",")) - {"cpu", ""}:
-        return True
-    # The axon relay plugin registers itself (and resets jax_platforms to
-    # prefer itself) whenever this var is set, regardless of JAX_PLATFORMS.
-    return bool(os.environ.get("PALLAS_AXON_POOL_IPS"))
-
-
-def _probe_backend(timeout_s):
-    """Returns the platform string of device 0, or None if unreachable.
-
-    A wedged relay makes backend init *hang* rather than error (observed
-    round 4: a dial-retry sleep loop inside plugin init), and a down relay
-    can also degrade to a silent CPU fallback — so 'cpu' from an
-    accelerator-configured environment counts as unreachable, same as the
-    r3b recovery battery's probe.
-    """
-    try:
-        proc = subprocess.run(
-            [sys.executable, "-c", _PROBE_SRC],
-            capture_output=True, text=True, timeout=max(timeout_s, 1.0),
-        )
-    except subprocess.TimeoutExpired:
-        return None
-    if proc.returncode != 0:
-        return None
-    platform = proc.stdout.split()[0] if proc.stdout.split() else None
-    if platform == "cpu" and _accelerator_expected():
-        return None
-    return platform
-
-
-def wait_for_backend(deadline_s=600.0, poll_s=30.0, probe_s=90.0):
-    """Poll the accelerator relay until it answers or the deadline passes.
-
-    The round-3 snapshot lost its headline number to a transient relay
-    outage (BENCH_r03.json rc=1): bench.py errored out instantly while the
-    outage resolved hours later.  A bounded wait degrades a transient
-    outage into a late number instead of a missing round.  Logs attempts
-    to stderr.  CPU-only environments (no accelerator configured) skip the
-    probe entirely, and healthy accelerator environments pay one probe
-    (~10-30s subprocess JAX init — noise next to the multi-minute relay
-    compile).  Per-probe timeouts are clamped to the remaining deadline so
-    the total wait honors ``deadline_s`` even for small values.
-    """
-    if not _accelerator_expected():
-        return "cpu"
-    t0 = time.monotonic()
-    attempt = 0
-    while True:
-        attempt += 1
-        remaining = deadline_s - (time.monotonic() - t0)
-        platform = _probe_backend(timeout_s=min(probe_s, max(remaining, 1.0)))
-        if platform is not None:
-            if attempt > 1:
-                print(
-                    f"bench: backend '{platform}' reachable after "
-                    f"{time.monotonic() - t0:.0f}s ({attempt} probes)",
-                    file=sys.stderr,
-                )
-            return platform
-        remaining = deadline_s - (time.monotonic() - t0)
-        if remaining <= poll_s:
-            print(
-                f"bench: backend unreachable after "
-                f"{time.monotonic() - t0:.0f}s ({attempt} probes); "
-                "proceeding anyway",
-                file=sys.stderr,
-            )
-            return None
-        print(
-            f"bench: backend probe {attempt} failed at "
-            f"{time.monotonic() - t0:.0f}s; retrying in {poll_s:.0f}s",
-            file=sys.stderr,
-        )
-        time.sleep(poll_s)
+# Relay probing lives in sav_tpu.utils.backend_probe (shared with
+# train.py --backend-wait; round-3's lost headline number motivated the
+# bounded wait, round-5's wedged-grant episode moved it into the library).
+# Imported inside main() so --help never pays the sav_tpu import.
 
 def _make_trainer(model_name, batch_size, backend, image_size,
                   device_preprocess=False, augment=None):
@@ -379,16 +290,9 @@ def main(argv=None):
             "f32 batches, so the combination would mislabel the metric"
         )
     if args.backend_wait > 0 and "pytest" not in sys.modules:
-        if wait_for_backend(deadline_s=args.backend_wait) is None:
-            # Proceeding would hang in main-process backend init (the
-            # wedged-relay failure mode is a hang, not an error); a prompt
-            # nonzero exit with a clear message beats a driver-killed hang.
-            print(
-                "bench: accelerator backend unreachable within "
-                f"--backend-wait={args.backend_wait:.0f}s; aborting",
-                file=sys.stderr,
-            )
-            return 3
+        from sav_tpu.utils.backend_probe import require_backend_or_exit
+
+        require_backend_or_exit(args.backend_wait, tag="bench")
 
     value, n_chips, extra = run(
         args.model, args.batch_size, args.steps, args.backend,
@@ -398,9 +302,9 @@ def main(argv=None):
     feed_desc = args.feed + (
         " uint8+device-preprocess" if args.device_preprocess else ""
     )
-    # NOTE: the only module-level 'import jax' lives inside the _PROBE_SRC
-    # string; heavy imports stay function-local so --help and the probe
-    # path never pay for a backend init.
+    # Heavy imports stay function-local so --help never pays for them; the
+    # relay probe itself runs in a subprocess (sav_tpu.utils.backend_probe,
+    # stdlib-only module behind lazy package re-exports).
     import jax
 
     out = {

@@ -1,44 +1,62 @@
-from sav_tpu.utils.metrics import topk_correct, accuracy_topk, cross_entropy
-from sav_tpu.utils.param_overview import (
-    count_parameters,
-    log_parameter_overview,
-    parameter_overview,
-)
-from sav_tpu.utils.profiler import StepTimer, annotate, benchmark_fn, trace
-from sav_tpu.utils.debug import (
-    assert_all_finite,
-    checkify_step,
-    find_nonfinite,
-    global_norm_nonfinite,
-)
-from sav_tpu.utils.writers import (
-    JsonlWriter,
-    LoggingWriter,
-    MetricWriter,
-    MultiWriter,
-    TensorBoardWriter,
-    WandbWriter,
+"""Utility surface: metrics, parameter overviews, profiling, debug, writers.
+
+Re-exports are lazy (PEP 562): importing a stdlib-only submodule such as
+``sav_tpu.utils.backend_probe`` must not drag ``jax`` into the process —
+the probe runs on the exact path (down/wedged relay) where every heavy
+import delays the abort decision.
+"""
+
+from __future__ import annotations
+
+_EXPORTS = {
+    "topk_correct": "sav_tpu.utils.metrics",
+    "accuracy_topk": "sav_tpu.utils.metrics",
+    "cross_entropy": "sav_tpu.utils.metrics",
+    "count_parameters": "sav_tpu.utils.param_overview",
+    "parameter_overview": "sav_tpu.utils.param_overview",
+    "log_parameter_overview": "sav_tpu.utils.param_overview",
+    "StepTimer": "sav_tpu.utils.profiler",
+    "annotate": "sav_tpu.utils.profiler",
+    "benchmark_fn": "sav_tpu.utils.profiler",
+    "trace": "sav_tpu.utils.profiler",
+    "assert_all_finite": "sav_tpu.utils.debug",
+    "checkify_step": "sav_tpu.utils.debug",
+    "find_nonfinite": "sav_tpu.utils.debug",
+    "global_norm_nonfinite": "sav_tpu.utils.debug",
+    "JsonlWriter": "sav_tpu.utils.writers",
+    "LoggingWriter": "sav_tpu.utils.writers",
+    "MetricWriter": "sav_tpu.utils.writers",
+    "MultiWriter": "sav_tpu.utils.writers",
+    "TensorBoardWriter": "sav_tpu.utils.writers",
+    "WandbWriter": "sav_tpu.utils.writers",
+}
+
+__all__ = list(_EXPORTS)
+
+
+_SUBMODULES = frozenset(
+    {"backend_probe", "debug", "metrics", "param_overview", "profiler",
+     "writers"}
 )
 
-__all__ = [
-    "topk_correct",
-    "accuracy_topk",
-    "cross_entropy",
-    "count_parameters",
-    "parameter_overview",
-    "log_parameter_overview",
-    "StepTimer",
-    "annotate",
-    "benchmark_fn",
-    "trace",
-    "assert_all_finite",
-    "checkify_step",
-    "find_nonfinite",
-    "global_norm_nonfinite",
-    "JsonlWriter",
-    "LoggingWriter",
-    "MetricWriter",
-    "MultiWriter",
-    "TensorBoardWriter",
-    "WandbWriter",
-]
+
+def __getattr__(name: str):
+    import importlib
+
+    if name in _SUBMODULES:
+        # Eager imports used to bind submodules as package attributes
+        # (`sav_tpu.utils.metrics` after `import sav_tpu.utils`); keep that
+        # working lazily too.
+        module = importlib.import_module(f"sav_tpu.utils.{name}")
+        globals()[name] = module
+        return module
+    target = _EXPORTS.get(name)
+    if target is None:
+        raise AttributeError(f"module 'sav_tpu.utils' has no attribute {name!r}")
+    value = getattr(importlib.import_module(target), name)
+    globals()[name] = value
+    return value
+
+
+def __dir__():
+    return sorted(set(globals()) | set(__all__))

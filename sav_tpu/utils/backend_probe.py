@@ -1,0 +1,131 @@
+"""Bounded accelerator-backend probing (failure detection for on-chip runs).
+
+On this stack the failure mode of a down or wedged TPU relay is a *hang*
+inside PJRT plugin init — not an error (observed rounds 3-5: a dial-retry
+sleep loop inside the plugin, and a wedged chip grant after a client died
+holding it). Any process that initializes the backend in-process therefore
+hangs uninterruptibly. These helpers probe from a **subprocess** with a
+timeout, so callers can degrade a transient outage into a late start or a
+prompt, clearly-labeled abort instead of a silently hung job.
+
+Counterpart of the reference's startup failure-detection (its trainer
+surfaces NCCL init errors and aborts; /root/reference/train.py:77-109
+context) — on a tunneled TPU the equivalent guard has to be an external
+probe because the in-process path cannot time out.
+
+Used by ``bench.py --backend-wait`` and ``train.py --backend-wait``.
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+import time
+
+# device_get of a computed value, not block_until_ready — the relay can ack
+# transfers early (see docs/benchmarking.md).
+_PROBE_SRC = """
+import jax, jax.numpy as jnp
+print(jax.devices()[0].platform)
+print(jax.device_get((jnp.ones((128, 128), jnp.bfloat16)
+                      @ jnp.ones((128, 128), jnp.bfloat16)).sum()))
+"""
+
+
+def accelerator_expected() -> bool:
+    """True when the environment is configured for a non-CPU backend."""
+    platforms = os.environ.get("JAX_PLATFORMS", "").strip().lower()
+    if platforms and set(platforms.split(",")) - {"cpu", ""}:
+        return True
+    # The axon relay plugin registers itself (and resets jax_platforms to
+    # prefer itself) whenever this var is set, regardless of JAX_PLATFORMS.
+    return bool(os.environ.get("PALLAS_AXON_POOL_IPS"))
+
+
+def probe_backend(timeout_s: float):
+    """Platform string of device 0, or None if unreachable.
+
+    'cpu' from an accelerator-configured environment counts as unreachable
+    (a down relay can degrade to a silent CPU fallback).
+    """
+    try:
+        proc = subprocess.run(
+            [sys.executable, "-c", _PROBE_SRC],
+            capture_output=True, text=True, timeout=max(timeout_s, 1.0),
+        )
+    except subprocess.TimeoutExpired:
+        return None
+    if proc.returncode != 0:
+        return None
+    platform = proc.stdout.split()[0] if proc.stdout.split() else None
+    if platform == "cpu" and accelerator_expected():
+        return None
+    return platform
+
+
+def wait_for_backend(deadline_s: float = 600.0, poll_s: float = 30.0,
+                     probe_s: float = 90.0, tag: str = "backend-probe"):
+    """Poll the accelerator relay until it answers or the deadline passes.
+
+    Returns the platform string, or None when the deadline expired (the
+    caller decides whether to proceed or abort — proceeding will hang if
+    the relay is truly wedged). CPU-only environments skip the probe and
+    return 'cpu'; healthy accelerator environments pay one subprocess JAX
+    init (~10-30 s — noise next to the multi-minute relay compile).
+    Per-probe timeouts are clamped to the remaining deadline so the total
+    wait honors ``deadline_s`` even for small values. Logs to stderr under
+    ``tag``.
+    """
+    if not accelerator_expected():
+        return "cpu"
+    t0 = time.monotonic()
+    attempt = 0
+    while True:
+        attempt += 1
+        remaining = deadline_s - (time.monotonic() - t0)
+        platform = probe_backend(timeout_s=min(probe_s, max(remaining, 1.0)))
+        if platform is not None:
+            if attempt > 1:
+                print(
+                    f"{tag}: backend '{platform}' reachable after "
+                    f"{time.monotonic() - t0:.0f}s ({attempt} probes)",
+                    file=sys.stderr,
+                )
+            return platform
+        remaining = deadline_s - (time.monotonic() - t0)
+        if remaining <= poll_s:
+            print(
+                f"{tag}: backend unreachable after "
+                f"{time.monotonic() - t0:.0f}s ({attempt} probes); "
+                "giving up",
+                file=sys.stderr,
+            )
+            return None
+        print(
+            f"{tag}: backend probe {attempt} failed at "
+            f"{time.monotonic() - t0:.0f}s; retrying in {poll_s:.0f}s",
+            file=sys.stderr,
+        )
+        time.sleep(poll_s)
+
+
+def require_backend_or_exit(deadline_s: float, tag: str, exit_code: int = 3):
+    """``wait_for_backend`` or abort the process with ``exit_code``.
+
+    Single definition of the abort contract (message format + exit 3) that
+    wrapper scripts key on; used by both ``bench.py`` and ``train.py`` so
+    the two CLIs cannot drift. Returns the platform string on success.
+    """
+    platform = wait_for_backend(deadline_s=deadline_s, tag=tag)
+    if platform is None:
+        # Proceeding would hang in in-process backend init (the wedged
+        # relay fails by hanging, not erroring); a prompt labeled exit
+        # beats a job that stalls forever holding its slot.
+        print(
+            f"{tag}: accelerator backend unreachable within "
+            f"--backend-wait={deadline_s:.0f}s; aborting",
+            file=sys.stderr,
+        )
+        raise SystemExit(exit_code)
+    return platform

@@ -1,0 +1,78 @@
+"""Bounded backend probing (sav_tpu/utils/backend_probe.py).
+
+A down/wedged relay hangs in-process backend init, so train.py/bench.py
+gate on a subprocess probe. These tests pin the decision logic; the
+subprocess probe itself is exercised for real by every on-chip run.
+"""
+
+import sav_tpu.utils.backend_probe as bp
+
+
+def _clear(monkeypatch):
+    monkeypatch.delenv("JAX_PLATFORMS", raising=False)
+    monkeypatch.delenv("PALLAS_AXON_POOL_IPS", raising=False)
+
+
+def test_accelerator_not_expected_when_env_empty(monkeypatch):
+    _clear(monkeypatch)
+    assert not bp.accelerator_expected()
+
+
+def test_accelerator_not_expected_when_cpu_pinned(monkeypatch):
+    _clear(monkeypatch)
+    monkeypatch.setenv("JAX_PLATFORMS", "cpu")
+    assert not bp.accelerator_expected()
+
+
+def test_accelerator_expected_with_relay_trigger(monkeypatch):
+    _clear(monkeypatch)
+    monkeypatch.setenv("PALLAS_AXON_POOL_IPS", "127.0.0.1")
+    assert bp.accelerator_expected()
+
+
+def test_accelerator_expected_with_tpu_platform(monkeypatch):
+    _clear(monkeypatch)
+    monkeypatch.setenv("JAX_PLATFORMS", "axon,cpu")
+    assert bp.accelerator_expected()
+
+
+def test_wait_short_circuits_cpu_only(monkeypatch):
+    _clear(monkeypatch)
+    # No subprocess spawned: returns immediately without burning the deadline.
+    monkeypatch.setattr(
+        bp, "probe_backend", lambda **kw: (_ for _ in ()).throw(AssertionError)
+    )
+    assert bp.wait_for_backend(deadline_s=0.01) == "cpu"
+
+
+def test_wait_gives_up_at_deadline(monkeypatch):
+    _clear(monkeypatch)
+    monkeypatch.setenv("PALLAS_AXON_POOL_IPS", "127.0.0.1")
+    monkeypatch.setattr(bp, "probe_backend", lambda timeout_s: None)
+    assert bp.wait_for_backend(deadline_s=0.05, poll_s=0.01) is None
+
+
+def test_wait_returns_platform_on_success(monkeypatch):
+    _clear(monkeypatch)
+    monkeypatch.setenv("PALLAS_AXON_POOL_IPS", "127.0.0.1")
+    monkeypatch.setattr(bp, "probe_backend", lambda timeout_s: "axon")
+    assert bp.wait_for_backend(deadline_s=5.0) == "axon"
+
+
+def test_cpu_platform_counts_as_unreachable_when_accel_expected(monkeypatch):
+    _clear(monkeypatch)
+    monkeypatch.setenv("PALLAS_AXON_POOL_IPS", "127.0.0.1")
+    calls = []
+
+    def fake_run(cmd, **kw):
+        calls.append(cmd)
+
+        class P:
+            returncode = 0
+            stdout = "cpu\n16384.0\n"
+
+        return P()
+
+    monkeypatch.setattr(bp.subprocess, "run", fake_run)
+    assert bp.probe_backend(timeout_s=5.0) is None
+    assert calls

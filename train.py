@@ -15,6 +15,7 @@ Examples:
 from __future__ import annotations
 
 import json
+import sys
 
 import click
 
@@ -135,6 +136,14 @@ import click
     "relay is unavailable.",
 )
 @click.option(
+    "--backend-wait", type=float, default=600.0,
+    help="Seconds to poll the accelerator relay (from a subprocess) before "
+    "aborting with exit 3. A down or wedged relay makes in-process backend "
+    "init HANG rather than error, so without this guard an on-chip run "
+    "stalls forever holding its slot. 0 disables. Ignored with "
+    "--platform cpu.",
+)
+@click.option(
     "--fused-optimizer/--no-fused-optimizer", default=None,
     help="Adam moments on one flat buffer (default: auto — on for pure "
     "data-parallel meshes). Pass --no-fused-optimizer to resume checkpoints "
@@ -156,13 +165,18 @@ def main(
     remat, dtype, tp, fsdp, sp, sp_method, pp, pp_microbatches, preset,
     checkpoint_dir, init_from,
     eval_only, steps, num_train_images,
-    num_eval_images, crop_min_area, train_flip, platform, fused_optimizer,
+    num_eval_images, crop_min_area, train_flip, platform, backend_wait,
+    fused_optimizer,
     device_preprocess, seed,
 ):
     import jax
 
     if platform == "cpu":
         jax.config.update("jax_platforms", "cpu")
+    elif backend_wait > 0 and "pytest" not in sys.modules:
+        from sav_tpu.utils.backend_probe import require_backend_or_exit
+
+        require_backend_or_exit(backend_wait, tag="train")
 
     from sav_tpu.parallel import distributed_init
     from sav_tpu.train import TrainConfig, Trainer, get_preset

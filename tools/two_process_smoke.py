@@ -1,5 +1,5 @@
 #!/usr/bin/env python
-"""Two-process distributed smoke: multi-process init → mesh → DP train step.
+"""Two-process distributed smoke: multi-process init → mesh → DP/TP steps.
 
 VERDICT r3 item 8: nothing had ever *executed* the multi-process bring-up
 path (``distributed_init`` → ``jax.distributed.initialize`` → one global
@@ -18,7 +18,17 @@ SPMD (input_pipeline.py:102, train.py:96):
   bit-for-bit (the gradient AllReduce crossed the process boundary) and
   that a second step decreases the loss.
 
-Run: ``python tools/two_process_smoke.py`` (CPU; ~1-2 min on one core).
+``--mode tp`` (round 5) goes further: the mesh is laid out so the
+``model`` axis itself SPANS the process boundary (device array
+transposed: each model-parallel pair has one device in each process), so
+the tensor-parallel activation psums — not just the gradient AllReduce —
+cross processes. The parent additionally runs the same config
+single-process on an identically-shaped ``data=2 × model=2`` mesh and
+asserts the loss sequence is bit-for-bit identical: device placement
+changes the transport (cross-process collectives vs shared memory), never
+the numerics.
+
+Run: ``python tools/two_process_smoke.py`` (CPU; runs both modes).
 Committed output: evidence/two_process_smoke.txt.
 """
 
@@ -34,21 +44,10 @@ N_LOCAL_DEVICES = 2
 NUM_PROCESSES = 2
 
 
-def worker(rank: int, coordinator: str) -> None:
-    from sav_tpu.parallel import create_mesh, distributed_init
+def _config(mode: str):
+    from sav_tpu.train import TrainConfig
 
-    distributed_init(coordinator, NUM_PROCESSES, rank)
-
-    import jax
-    import numpy as np
-
-    assert jax.process_count() == NUM_PROCESSES, jax.process_count()
-    n_global = NUM_PROCESSES * N_LOCAL_DEVICES
-    assert len(jax.devices()) == n_global, jax.devices()
-
-    from sav_tpu.train import TrainConfig, Trainer
-
-    config = TrainConfig(
+    return TrainConfig(
         model_name="vit_ti_patch16",
         num_classes=10,
         image_size=32,
@@ -61,22 +60,23 @@ def worker(rank: int, coordinator: str) -> None:
         transpose_images=False,
         model_overrides=dict(num_layers=2, embed_dim=64, num_heads=4),
         seed=0,
+        mesh_axes={"data": 2, "model": 2} if mode == "tp" else None,
     )
-    trainer = Trainer(config)
-    mesh = trainer.mesh
-    assert mesh.devices.size == n_global, mesh
 
-    # Per-host batch shard: every process derives the SAME global batch from
-    # the seed, then keeps its half — exactly the data pipeline's per-host
-    # sharding contract (sav_tpu/data/pipeline.py process_index/count).
+
+def _global_batch():
+    import numpy as np
+
     rng = np.random.default_rng(0)
     labels = rng.integers(0, 10, (GLOBAL_BATCH,))
     images = (
         labels[:, None, None, None] * 20 + rng.normal(0, 8, (GLOBAL_BATCH, 32, 32, 3))
     ).astype(np.float32) / 127.5 - 1.0
-    per_host = GLOBAL_BATCH // NUM_PROCESSES
-    sl = slice(rank * per_host, (rank + 1) * per_host)
-    batch = {"images": images[sl], "labels": labels[sl].astype(np.int32)}
+    return images, labels
+
+
+def _run_steps(trainer, batch, tag: str) -> None:
+    import jax
 
     state = trainer.init_state(0)
     losses = []
@@ -85,26 +85,104 @@ def worker(rank: int, coordinator: str) -> None:
     for i in range(6):
         state, metrics = trainer.train_step(state, batch, jax.random.PRNGKey(i))
         losses.append(float(jax.device_get(metrics["loss"])))
-    print("RANK %d LOSS %s" % (rank, " ".join(f"{l:.9f}" for l in losses)), flush=True)
+    print("%s LOSS %s" % (tag, " ".join(f"{l:.9f}" for l in losses)), flush=True)
+
+
+def single_tp() -> None:
+    """Single-process reference: same data=2 x model=2 shape, local devices."""
+    import jax
+    import numpy as np
+    from jax.sharding import Mesh
+
+    n = NUM_PROCESSES * N_LOCAL_DEVICES
+    devs = np.asarray(jax.devices()[:n]).reshape(NUM_PROCESSES, N_LOCAL_DEVICES)
+    from sav_tpu.train import Trainer
+
+    trainer = Trainer(_config("tp"), mesh=Mesh(devs, ("data", "model")))
+    images, labels = _global_batch()
+    _run_steps(
+        trainer, {"images": images, "labels": labels.astype(np.int32)}, "SINGLE"
+    )
+
+
+def worker(rank: int, coordinator: str, mode: str) -> None:
+    from sav_tpu.parallel import distributed_init
+
+    distributed_init(coordinator, NUM_PROCESSES, rank)
+
+    import jax
+    import numpy as np
+
+    assert jax.process_count() == NUM_PROCESSES, jax.process_count()
+    n_global = NUM_PROCESSES * N_LOCAL_DEVICES
+    assert len(jax.devices()) == n_global, jax.devices()
+
+    from sav_tpu.train import Trainer
+
+    config = _config(mode)
+    if mode == "tp":
+        from jax.sharding import Mesh
+
+        # Transposed layout: jax.devices() orders [p0d0, p0d1, p1d0, p1d1];
+        # reshape(2, 2).T puts one device from EACH process in every
+        # model-axis pair, so the TP activation psums cross the process
+        # boundary (the whole point of this mode).
+        devs = np.asarray(jax.devices()).reshape(NUM_PROCESSES, N_LOCAL_DEVICES).T
+        trainer = Trainer(config, mesh=Mesh(devs, ("data", "model")))
+    else:
+        trainer = Trainer(config)
+    mesh = trainer.mesh
+    assert mesh.devices.size == n_global, mesh
+
+    # Every process derives the SAME global batch from the seed. DP mode
+    # keeps its half — exactly the data pipeline's per-host sharding
+    # contract (sav_tpu/data/pipeline.py process_index/count). TP mode's
+    # transposed mesh puts one device of EVERY data group in each process,
+    # so each process's addressable portion is the full batch.
+    images, labels = _global_batch()
+    if mode == "tp":
+        batch = {"images": images, "labels": labels.astype(np.int32)}
+    else:
+        per_host = GLOBAL_BATCH // NUM_PROCESSES
+        sl = slice(rank * per_host, (rank + 1) * per_host)
+        batch = {"images": images[sl], "labels": labels[sl].astype(np.int32)}
+
+    _run_steps(trainer, batch, "RANK %d" % rank)
     jax.distributed.shutdown()
 
 
 def main() -> int:
+    mode = "dp"
+    if "--mode" in sys.argv:
+        mode = sys.argv[sys.argv.index("--mode") + 1]
+        if mode not in ("dp", "tp"):
+            print(f"unknown --mode {mode!r}; known: dp, tp", file=sys.stderr)
+            return 2
+    if "--single-tp" in sys.argv:
+        single_tp()
+        return 0
     if "--rank" in sys.argv:
         rank = int(sys.argv[sys.argv.index("--rank") + 1])
-        worker(rank, os.environ["SMOKE_COORDINATOR"])
+        worker(rank, os.environ["SMOKE_COORDINATOR"], mode)
         return 0
-    # bind-then-close port picking races other processes on the host; one
-    # retry with a fresh port covers the TOCTOU without masking real bugs
-    # (only rendezvous-setup errors trigger it).
-    rc = _run_once()
-    if rc == 2:
-        print("retrying once with a fresh coordinator port", flush=True)
-        rc = _run_once()
-    return rc
+    if "--mode" in sys.argv:
+        modes = [mode]
+    else:
+        modes = ["dp", "tp"]
+    for m in modes:
+        # bind-then-close port picking races other processes on the host; one
+        # retry with a fresh port covers the TOCTOU without masking real bugs
+        # (only rendezvous-setup errors trigger it).
+        rc = _run_once(m)
+        if rc == 2:
+            print("retrying once with a fresh coordinator port", flush=True)
+            rc = _run_once(m)
+        if rc != 0:
+            return rc
+    return 0
 
 
-def _run_once() -> int:
+def _run_once(mode: str = "dp") -> int:
     with socket.socket() as s:  # pick a free coordinator port
         s.bind(("127.0.0.1", 0))
         port = s.getsockname()[1]
@@ -125,9 +203,10 @@ def _run_once() -> int:
     )
     env["SMOKE_COORDINATOR"] = f"127.0.0.1:{port}"
 
+    print(f"=== mode {mode} ===", flush=True)
     procs = [
         subprocess.Popen(
-            [sys.executable, __file__, "--rank", str(r)],
+            [sys.executable, __file__, "--rank", str(r), "--mode", mode],
             env=env,
             stdout=subprocess.PIPE,
             stderr=subprocess.STDOUT,
@@ -168,8 +247,47 @@ def _run_once() -> int:
         return 1
     seq = losses[0]
     if not (seq[-1] < seq[0]):
-        print(f"FAIL: loss did not decrease over the DP steps: {seq}")
+        print(f"FAIL: loss did not decrease over the {mode} steps: {seq}")
         return 1
+    if mode == "tp":
+        # Single-process reference on an identically-shaped mesh: placement
+        # (cross-process vs shared-memory collectives) must not change bits.
+        env_s = dict(env)
+        # Rebuild from the ORIGINAL environment (not the workers' copy):
+        # string surgery on the appended flag risks mangling user flags.
+        env_s["XLA_FLAGS"] = (
+            os.environ.get("XLA_FLAGS", "")
+            + " --xla_force_host_platform_device_count="
+            f"{NUM_PROCESSES * N_LOCAL_DEVICES}"
+        )
+        env_s.pop("SMOKE_COORDINATOR")
+        proc = subprocess.run(
+            [sys.executable, __file__, "--single-tp"],
+            env=env_s, capture_output=True, text=True, timeout=900,
+        )
+        print(f"--- single-process reference (rc={proc.returncode}) ---")
+        print(proc.stdout)
+        single = None
+        for line in proc.stdout.splitlines():
+            if line.startswith("SINGLE"):
+                single = tuple(float(x) for x in line.split()[2:])
+        if proc.returncode != 0 or single is None:
+            print(proc.stderr)
+            print("FAIL: single-process tp reference did not complete")
+            return 1
+        if single != seq:
+            print(
+                "FAIL: cross-process tp losses differ from single-process "
+                f"placement: {seq} vs {single}"
+            )
+            return 1
+        print(
+            f"AGREE: tp losses {seq[0]:.9f} -> {seq[-1]:.9f} bit-for-bit "
+            "across ranks AND vs the single-process mesh — the model axis "
+            "spans the process boundary (activation psums over the "
+            "cross-process transport) without changing a single bit"
+        )
+        return 0
     print(
         f"AGREE: both processes computed losses {seq[0]:.9f} -> {seq[-1]:.9f} "
         f"bit-for-bit (one {NUM_PROCESSES}-process data-parallel mesh, "

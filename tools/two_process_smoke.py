@@ -1,5 +1,5 @@
 #!/usr/bin/env python
-"""Two-process distributed smoke: multi-process init → mesh → DP/TP steps.
+"""Two-process distributed smoke: multi-process init → mesh → dp/tp/sp steps.
 
 VERDICT r3 item 8: nothing had ever *executed* the multi-process bring-up
 path (``distributed_init`` → ``jax.distributed.initialize`` → one global
@@ -28,8 +28,12 @@ asserts the loss sequence is bit-for-bit identical: device placement
 changes the transport (cross-process collectives vs shared memory), never
 the numerics.
 
-Run: ``python tools/two_process_smoke.py`` (CPU; runs both modes).
-Committed output: evidence/two_process_smoke.txt.
+``--mode sp`` is the same transposed layout on the ``seq`` axis: the
+ring's K/V ppermute hops cross processes (ring attention multi-host).
+
+Run: ``python tools/two_process_smoke.py`` (CPU; runs all three modes —
+dp, tp, sp; ``--mode X`` for one). Committed output:
+evidence/two_process_smoke.txt.
 """
 
 from __future__ import annotations
@@ -44,9 +48,19 @@ N_LOCAL_DEVICES = 2
 NUM_PROCESSES = 2
 
 
+# mode → the mesh axis that joins 'data' (None = pure DP). In tp/sp modes
+# the worker mesh is transposed so that axis SPANS the process boundary.
+MODE_AXIS = {"dp": None, "tp": "model", "sp": "seq"}
+
+
 def _config(mode: str):
     from sav_tpu.train import TrainConfig
 
+    overrides = dict(num_layers=2, embed_dim=64, num_heads=4)
+    if mode == "sp":
+        # 32² at patch 8 → 17 tokens: odd length exercises the ring's
+        # pad-and-mask path across the process boundary.
+        overrides["patch_shape"] = (8, 8)
     return TrainConfig(
         model_name="vit_ti_patch16",
         num_classes=10,
@@ -58,9 +72,12 @@ def _config(mode: str):
         warmup_epochs=1,
         base_lr=0.05,  # LR auto-scales by batch/512; keep the step visible
         transpose_images=False,
-        model_overrides=dict(num_layers=2, embed_dim=64, num_heads=4),
+        model_overrides=overrides,
         seed=0,
-        mesh_axes={"data": 2, "model": 2} if mode == "tp" else None,
+        # No mesh_axes override: every tp/sp call site passes an explicit
+        # Mesh to Trainer (which then ignores config.mesh_axes) — a second
+        # copy of the shape here could silently drift from the real layout.
+        sequence_parallel="ring" if mode == "sp" else None,
     )
 
 
@@ -88,8 +105,8 @@ def _run_steps(trainer, batch, tag: str) -> None:
     print("%s LOSS %s" % (tag, " ".join(f"{l:.9f}" for l in losses)), flush=True)
 
 
-def single_tp() -> None:
-    """Single-process reference: same data=2 x model=2 shape, local devices."""
+def single_reference(mode: str) -> None:
+    """Single-process reference: same data=2 x <axis>=2 shape, local devices."""
     import jax
     import numpy as np
     from jax.sharding import Mesh
@@ -98,7 +115,9 @@ def single_tp() -> None:
     devs = np.asarray(jax.devices()[:n]).reshape(NUM_PROCESSES, N_LOCAL_DEVICES)
     from sav_tpu.train import Trainer
 
-    trainer = Trainer(_config("tp"), mesh=Mesh(devs, ("data", "model")))
+    trainer = Trainer(
+        _config(mode), mesh=Mesh(devs, ("data", MODE_AXIS[mode]))
+    )
     images, labels = _global_batch()
     _run_steps(
         trainer, {"images": images, "labels": labels.astype(np.int32)}, "SINGLE"
@@ -120,15 +139,16 @@ def worker(rank: int, coordinator: str, mode: str) -> None:
     from sav_tpu.train import Trainer
 
     config = _config(mode)
-    if mode == "tp":
+    axis = MODE_AXIS[mode]
+    if axis is not None:
         from jax.sharding import Mesh
 
         # Transposed layout: jax.devices() orders [p0d0, p0d1, p1d0, p1d1];
         # reshape(2, 2).T puts one device from EACH process in every
-        # model-axis pair, so the TP activation psums cross the process
-        # boundary (the whole point of this mode).
+        # model/seq-axis pair, so the TP activation psums (or the ring's
+        # kv ppermute hops) cross the process boundary — the whole point.
         devs = np.asarray(jax.devices()).reshape(NUM_PROCESSES, N_LOCAL_DEVICES).T
-        trainer = Trainer(config, mesh=Mesh(devs, ("data", "model")))
+        trainer = Trainer(config, mesh=Mesh(devs, ("data", axis)))
     else:
         trainer = Trainer(config)
     mesh = trainer.mesh
@@ -140,7 +160,7 @@ def worker(rank: int, coordinator: str, mode: str) -> None:
     # transposed mesh puts one device of EVERY data group in each process,
     # so each process's addressable portion is the full batch.
     images, labels = _global_batch()
-    if mode == "tp":
+    if mode in ("tp", "sp"):
         batch = {"images": images, "labels": labels.astype(np.int32)}
     else:
         per_host = GLOBAL_BATCH // NUM_PROCESSES
@@ -155,11 +175,18 @@ def main() -> int:
     mode = "dp"
     if "--mode" in sys.argv:
         mode = sys.argv[sys.argv.index("--mode") + 1]
-        if mode not in ("dp", "tp"):
-            print(f"unknown --mode {mode!r}; known: dp, tp", file=sys.stderr)
+        if mode not in MODE_AXIS:
+            print(
+                f"unknown --mode {mode!r}; known: {sorted(MODE_AXIS)}",
+                file=sys.stderr,
+            )
             return 2
-    if "--single-tp" in sys.argv:
-        single_tp()
+    if "--single" in sys.argv:
+        if MODE_AXIS[mode] is None:
+            print("--single needs --mode tp|sp (dp has no reference run)",
+                  file=sys.stderr)
+            return 2
+        single_reference(mode)
         return 0
     if "--rank" in sys.argv:
         rank = int(sys.argv[sys.argv.index("--rank") + 1])
@@ -168,7 +195,7 @@ def main() -> int:
     if "--mode" in sys.argv:
         modes = [mode]
     else:
-        modes = ["dp", "tp"]
+        modes = ["dp", "tp", "sp"]
     for m in modes:
         # bind-then-close port picking races other processes on the host; one
         # retry with a fresh port covers the TOCTOU without masking real bugs
@@ -249,7 +276,7 @@ def _run_once(mode: str = "dp") -> int:
     if not (seq[-1] < seq[0]):
         print(f"FAIL: loss did not decrease over the {mode} steps: {seq}")
         return 1
-    if mode == "tp":
+    if mode in ("tp", "sp"):
         # Single-process reference on an identically-shaped mesh: placement
         # (cross-process vs shared-memory collectives) must not change bits.
         env_s = dict(env)
@@ -262,7 +289,7 @@ def _run_once(mode: str = "dp") -> int:
         )
         env_s.pop("SMOKE_COORDINATOR")
         proc = subprocess.run(
-            [sys.executable, __file__, "--single-tp"],
+            [sys.executable, __file__, "--single", "--mode", mode],
             env=env_s, capture_output=True, text=True, timeout=900,
         )
         print(f"--- single-process reference (rc={proc.returncode}) ---")
@@ -273,19 +300,22 @@ def _run_once(mode: str = "dp") -> int:
                 single = tuple(float(x) for x in line.split()[2:])
         if proc.returncode != 0 or single is None:
             print(proc.stderr)
-            print("FAIL: single-process tp reference did not complete")
+            print(f"FAIL: single-process {mode} reference did not complete")
             return 1
         if single != seq:
             print(
-                "FAIL: cross-process tp losses differ from single-process "
-                f"placement: {seq} vs {single}"
+                f"FAIL: cross-process {mode} losses differ from "
+                f"single-process placement: {seq} vs {single}"
             )
             return 1
+        what = (
+            "activation psums" if mode == "tp" else "ring kv ppermute hops"
+        )
         print(
-            f"AGREE: tp losses {seq[0]:.9f} -> {seq[-1]:.9f} bit-for-bit "
-            "across ranks AND vs the single-process mesh — the model axis "
-            "spans the process boundary (activation psums over the "
-            "cross-process transport) without changing a single bit"
+            f"AGREE: {mode} losses {seq[0]:.9f} -> {seq[-1]:.9f} bit-for-bit "
+            f"across ranks AND vs the single-process mesh — the "
+            f"{MODE_AXIS[mode]} axis spans the process boundary ({what} "
+            "over the cross-process transport) without changing a single bit"
         )
         return 0
     print(

@@ -1,5 +1,5 @@
 #!/usr/bin/env python
-"""Two-process distributed smoke: multi-process init → mesh → dp/tp/sp steps.
+"""Two-process distributed smoke: multi-process init → mesh → dp/tp/sp/pp steps.
 
 VERDICT r3 item 8: nothing had ever *executed* the multi-process bring-up
 path (``distributed_init`` → ``jax.distributed.initialize`` → one global
@@ -30,9 +30,11 @@ the numerics.
 
 ``--mode sp`` is the same transposed layout on the ``seq`` axis: the
 ring's K/V ppermute hops cross processes (ring attention multi-host).
+``--mode pp`` puts the ``pipe`` axis across processes: the GPipe
+stage-boundary activation ppermutes ride the cross-process transport.
 
-Run: ``python tools/two_process_smoke.py`` (CPU; runs all three modes —
-dp, tp, sp; ``--mode X`` for one). Committed output:
+Run: ``python tools/two_process_smoke.py`` (CPU; runs all four modes —
+dp, tp, sp, pp; ``--mode X`` for one). Committed output:
 evidence/two_process_smoke.txt.
 """
 
@@ -48,19 +50,25 @@ N_LOCAL_DEVICES = 2
 NUM_PROCESSES = 2
 
 
-# mode → the mesh axis that joins 'data' (None = pure DP). In tp/sp modes
-# the worker mesh is transposed so that axis SPANS the process boundary.
-MODE_AXIS = {"dp": None, "tp": "model", "sp": "seq"}
+# mode → the mesh axis that joins 'data' (None = pure DP). In tp/sp/pp
+# modes the worker mesh is transposed so that axis SPANS the process
+# boundary.
+MODE_AXIS = {"dp": None, "tp": "model", "sp": "seq", "pp": "pipe"}
 
 
 def _config(mode: str):
     from sav_tpu.train import TrainConfig
 
     overrides = dict(num_layers=2, embed_dim=64, num_heads=4)
+    extra = {}
     if mode == "sp":
         # 32² at patch 8 → 17 tokens: odd length exercises the ring's
         # pad-and-mask path across the process boundary.
         overrides["patch_shape"] = (8, 8)
+    if mode == "pp":
+        # 2 stages x 1 encoder layer, 2 microbatches of 2 per data shard:
+        # the GPipe stage-boundary ppermute crosses the process boundary.
+        extra = dict(pipeline_parallel=2, pipeline_microbatches=2)
     return TrainConfig(
         model_name="vit_ti_patch16",
         num_classes=10,
@@ -78,6 +86,7 @@ def _config(mode: str):
         # Mesh to Trainer (which then ignores config.mesh_axes) — a second
         # copy of the shape here could silently drift from the real layout.
         sequence_parallel="ring" if mode == "sp" else None,
+        **extra,
     )
 
 
@@ -160,7 +169,7 @@ def worker(rank: int, coordinator: str, mode: str) -> None:
     # transposed mesh puts one device of EVERY data group in each process,
     # so each process's addressable portion is the full batch.
     images, labels = _global_batch()
-    if mode in ("tp", "sp"):
+    if MODE_AXIS[mode] is not None:
         batch = {"images": images, "labels": labels.astype(np.int32)}
     else:
         per_host = GLOBAL_BATCH // NUM_PROCESSES
@@ -183,7 +192,7 @@ def main() -> int:
             return 2
     if "--single" in sys.argv:
         if MODE_AXIS[mode] is None:
-            print("--single needs --mode tp|sp (dp has no reference run)",
+            print("--single needs --mode tp|sp|pp (dp has no reference run)",
                   file=sys.stderr)
             return 2
         single_reference(mode)
@@ -195,7 +204,7 @@ def main() -> int:
     if "--mode" in sys.argv:
         modes = [mode]
     else:
-        modes = ["dp", "tp", "sp"]
+        modes = ["dp", "tp", "sp", "pp"]
     for m in modes:
         # bind-then-close port picking races other processes on the host; one
         # retry with a fresh port covers the TOCTOU without masking real bugs
@@ -276,7 +285,7 @@ def _run_once(mode: str = "dp") -> int:
     if not (seq[-1] < seq[0]):
         print(f"FAIL: loss did not decrease over the {mode} steps: {seq}")
         return 1
-    if mode in ("tp", "sp"):
+    if MODE_AXIS[mode] is not None:
         # Single-process reference on an identically-shaped mesh: placement
         # (cross-process vs shared-memory collectives) must not change bits.
         env_s = dict(env)
@@ -308,9 +317,11 @@ def _run_once(mode: str = "dp") -> int:
                 f"single-process placement: {seq} vs {single}"
             )
             return 1
-        what = (
-            "activation psums" if mode == "tp" else "ring kv ppermute hops"
-        )
+        what = {
+            "tp": "activation psums",
+            "sp": "ring kv ppermute hops",
+            "pp": "GPipe stage-boundary ppermutes",
+        }[mode]
         print(
             f"AGREE: {mode} losses {seq[0]:.9f} -> {seq[-1]:.9f} bit-for-bit "
             f"across ranks AND vs the single-process mesh — the "

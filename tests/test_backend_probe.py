@@ -59,6 +59,20 @@ def test_wait_returns_platform_on_success(monkeypatch):
     assert bp.wait_for_backend(deadline_s=5.0) == "axon"
 
 
+def test_require_backend_or_exit_abort_contract(monkeypatch):
+    """Exit code 3 is the contract wrapper scripts key on; pin it."""
+    import pytest
+
+    _clear(monkeypatch)
+    monkeypatch.setenv("PALLAS_AXON_POOL_IPS", "127.0.0.1")
+    monkeypatch.setattr(bp, "probe_backend", lambda timeout_s: None)
+    with pytest.raises(SystemExit) as exc:
+        bp.require_backend_or_exit(0.05, tag="test")
+    assert exc.value.code == 3
+    monkeypatch.setattr(bp, "probe_backend", lambda timeout_s: "axon")
+    assert bp.require_backend_or_exit(5.0, tag="test") == "axon"
+
+
 def test_cpu_platform_counts_as_unreachable_when_accel_expected(monkeypatch):
     _clear(monkeypatch)
     monkeypatch.setenv("PALLAS_AXON_POOL_IPS", "127.0.0.1")

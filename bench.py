@@ -12,7 +12,10 @@ shows >5x transient slowdowns; the minimum step time is the honest
 hardware-capability number) and ``median_img_per_sec_per_chip`` is the
 median window — both reported so the methodology is transparent
 (ADVICE r1). ``mfu`` is model-FLOPs utilization from the compiled step's
-XLA cost analysis against the chip's peak bf16 FLOP/s.
+XLA cost analysis against the chip's peak bf16 FLOP/s. ``goodput`` is the
+run's wall-time ledger (sav_tpu.obs.goodput, docs/observability.md):
+compile / step / input-wait buckets plus the per-window stall anomalies
+that make the >5x transient slowdowns visible in the recorded JSON.
 
 Feeds (``--feed``):
   synthetic — one device-resident batch, re-stepped (pure device number)
@@ -115,6 +118,13 @@ def run(model_name, batch_size, steps, backend, image_size, reps, feed,
     import jax
 
     from sav_tpu.data import synthetic_data_iterator
+    from sav_tpu.obs.goodput import GoodputLedger
+
+    # Wall-time ledger over the whole measurement (docs/observability.md):
+    # compile vs step vs input-wait decomposition plus per-window stall
+    # anomalies — on the relayed bench chip the >5x transient slowdowns
+    # are exactly what separates `value` (best window) from the median.
+    ledger = GoodputLedger()
 
     # Keep both A/B arms doing the same work: the savrec path never mixes
     # on the host, so its device_preprocess trainer must not mix either;
@@ -145,23 +155,27 @@ def run(model_name, batch_size, steps, backend, image_size, reps, feed,
         # dispatch cache, so mixing AOT + jit would compile twice).
         from sav_tpu.utils.flops import compiled_flops, per_chip_peak_flops
 
-        step = trainer._train_step.lower(state, sharded, rng).compile()
+        with ledger.measure("compile"):
+            step = trainer._train_step.lower(state, sharded, rng).compile()
         flops = compiled_flops(step) or None
 
         # Warmup. Sync via device_get of the loss value — on relayed/remote
         # platforms block_until_ready alone can return before execution
         # completes.
-        for _ in range(2):
-            state, metrics = step(state, sharded, rng)
-        float(jax.device_get(metrics["loss"]))
+        with ledger.measure("step"):
+            for _ in range(2):
+                state, metrics = step(state, sharded, rng)
+            float(jax.device_get(metrics["loss"]))
 
         windows = []
-        for _ in range(reps):
+        for rep in range(reps):
             t0 = time.perf_counter()
             for _ in range(steps):
                 state, metrics = step(state, sharded, rng)
             float(jax.device_get(metrics["loss"]))
-            windows.append((time.perf_counter() - t0) / steps)
+            elapsed = time.perf_counter() - t0
+            ledger.note_window(steps, elapsed, step=(rep + 1) * steps)
+            windows.append(elapsed / steps)
         if flops is not None:
             # cost_analysis FLOPs are per-device → MFU is per chip.
             peak = per_chip_peak_flops()
@@ -190,16 +204,18 @@ def run(model_name, batch_size, steps, backend, image_size, reps, feed,
             next(it)  # warm caches / tf.data autotune
         t0 = time.perf_counter()
         host_steps = max(steps // 2, 5)
-        for _ in range(host_steps):
-            next(it)
+        with ledger.measure("input_wait"):
+            for _ in range(host_steps):
+                next(it)
         host_rate = batch_size * host_steps / (time.perf_counter() - t0)
         result["host_pipeline_img_per_sec"] = round(host_rate, 1)
 
         # End-to-end: pipeline feeding the real train step.
         it = _feed_iterator(feed, batch_size, image_size, tmpdir, device_preprocess)
         first = next(it)
-        state, metrics = trainer.train_step(state, first, rng)
-        float(jax.device_get(metrics["loss"]))
+        with ledger.measure("compile"):
+            state, metrics = trainer.train_step(state, first, rng)
+            float(jax.device_get(metrics["loss"]))
         # Host->device transfer cost for one batch, measured *after* device
         # compute has run: on some rigs (the relayed bench chip) transfer
         # bandwidth degrades sharply once a program has executed, and this
@@ -216,23 +232,29 @@ def run(model_name, batch_size, steps, backend, image_size, reps, feed,
         _sum_placed = jax.jit(lambda b: jnp.sum(b.astype(jnp.float32)))
         jax.device_get(_sum_placed(trainer.shard_batch(first)["images"]))
         transfer_s = float("inf")
-        for _ in range(3):
-            t0 = time.perf_counter()
-            placed = trainer.shard_batch(first)
-            jax.device_get(_sum_placed(placed["images"]))
-            transfer_s = min(transfer_s, time.perf_counter() - t0)
+        with ledger.measure("input_wait"):
+            for _ in range(3):
+                t0 = time.perf_counter()
+                placed = trainer.shard_batch(first)
+                jax.device_get(_sum_placed(placed["images"]))
+                transfer_s = min(transfer_s, time.perf_counter() - t0)
         nbytes = sum(
             getattr(v, "nbytes", 0) for v in first.values()
         )
         result["transfer_ms_per_batch"] = round(transfer_s * 1e3, 1)
         result["transfer_mb_per_s"] = round(nbytes / transfer_s / 1e6, 1)
         windows = []
-        for _ in range(reps):
+        for rep in range(reps):
             t0 = time.perf_counter()
             for _ in range(steps):
                 state, metrics = trainer.train_step(state, next(it), rng)
             float(jax.device_get(metrics["loss"]))
-            windows.append((time.perf_counter() - t0) / steps)
+            elapsed = time.perf_counter() - t0
+            # Fed windows interleave host fetch + transfer + device step;
+            # the ledger books them as 'step' (end-to-end goodput), with
+            # the host-only and transfer shares reported separately above.
+            ledger.note_window(steps, elapsed, step=(rep + 1) * steps)
+            windows.append(elapsed / steps)
 
     n_chips = len(jax.devices())
     best = min(windows)
@@ -241,6 +263,7 @@ def run(model_name, batch_size, steps, backend, image_size, reps, feed,
         median_img_per_sec_per_chip=round(
             batch_size / statistics.median(windows) / n_chips, 1
         ),
+        goodput=ledger.summary(),
     )
     return batch_size / best / n_chips, n_chips, result
 

@@ -1,0 +1,63 @@
+"""Run telemetry — the observability layer for training runs.
+
+Five signals, one design rule each:
+
+- :mod:`sav_tpu.obs.diagnostics` — **in-jit** optimization diagnostics
+  (grad/param/update norms, update-to-param ratio, per-layer-group grad
+  norms, nonfinite counts) folded into the step-metrics dict so they ride
+  the existing per-log ``device_get`` with zero extra transfers.
+- :mod:`sav_tpu.obs.spans` — **host-side** span tracer emitting
+  Chrome-trace-event JSON (Perfetto-loadable) around ``fit()``'s phases,
+  so input-bound vs compute-bound is diagnosable without an XPlane capture.
+- :mod:`sav_tpu.obs.goodput` — wall-time ledger splitting a run into
+  compile / step / input-wait / eval / checkpoint / stall buckets, with
+  per-window anomaly flags for the relay's >5x transient slowdowns.
+- :mod:`sav_tpu.obs.memory` — HBM telemetry from ``device.memory_stats()``
+  plus a retrace counter that makes silent recompilation visible.
+- :mod:`sav_tpu.obs.watchdog` — heartbeat thread that turns a steady-state
+  hang (the relay's documented failure mode, ``utils/backend_probe``) into
+  a stack dump + labeled exit instead of a job that stalls forever.
+
+Re-exports are lazy (PEP 562, same pattern as :mod:`sav_tpu.utils`):
+:mod:`spans`, :mod:`goodput`, and :mod:`watchdog` are stdlib-only and must
+stay importable without dragging ``jax`` into the process.
+"""
+
+from __future__ import annotations
+
+_EXPORTS = {
+    "diagnostics_metrics": "sav_tpu.obs.diagnostics",
+    "grad_group_norms": "sav_tpu.obs.diagnostics",
+    "nonfinite_count": "sav_tpu.obs.diagnostics",
+    "SpanTracer": "sav_tpu.obs.spans",
+    "GoodputLedger": "sav_tpu.obs.goodput",
+    "hbm_stats": "sav_tpu.obs.memory",
+    "RetraceCounter": "sav_tpu.obs.memory",
+    "HangWatchdog": "sav_tpu.obs.watchdog",
+    "WATCHDOG_EXIT_CODE": "sav_tpu.obs.watchdog",
+}
+
+__all__ = list(_EXPORTS)
+
+_SUBMODULES = frozenset(
+    {"diagnostics", "spans", "goodput", "memory", "watchdog"}
+)
+
+
+def __getattr__(name: str):
+    import importlib
+
+    if name in _SUBMODULES:
+        module = importlib.import_module(f"sav_tpu.obs.{name}")
+        globals()[name] = module
+        return module
+    target = _EXPORTS.get(name)
+    if target is None:
+        raise AttributeError(f"module 'sav_tpu.obs' has no attribute {name!r}")
+    value = getattr(importlib.import_module(target), name)
+    globals()[name] = value
+    return value
+
+
+def __dir__():
+    return sorted(set(globals()) | set(__all__))

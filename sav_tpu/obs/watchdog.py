@@ -1,0 +1,144 @@
+"""Steady-state hang watchdog.
+
+``utils.backend_probe`` guards *startup*: a down/wedged TPU relay hangs
+in-process backend init, so the CLIs probe from a subprocess before
+touching jax. This module extends that philosophy to *steady state*: once
+training is running, the same relay failure mode (observed rounds 3-5 —
+a dial-retry loop inside the plugin, a wedged chip grant) presents as a
+step that never completes, usually with the host blocked inside
+``device_get``. Without a watchdog that is a job silently holding its
+slot forever; BENCH_r05.json's rc=3 came after 570 s of probing for
+exactly this reason.
+
+:class:`HangWatchdog` is a daemon heartbeat thread. The train loop calls
+:meth:`beat` every iteration; if no beat arrives within ``deadline_s``
+the watchdog dumps every Python thread's stack (so the blocked
+``device_get``/``next(iterator)`` frame is in the log), the goodput
+ledger summary if one was attached, and exits the process with
+:data:`WATCHDOG_EXIT_CODE` — distinct from the backend probe's exit 3 so
+wrapper scripts can tell "never started" from "hung mid-run".
+
+Stdlib-only, and ``os._exit`` (not ``sys.exit``) by design: the main
+thread is presumed wedged in a C call that never returns, so unwinding
+it is not an option.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import threading
+import time
+import traceback
+from typing import Callable, Optional
+
+# Exit-code contract: backend_probe aborts startup with 3; the watchdog
+# aborts a hung steady-state run with 4. Wrapper scripts key on both.
+WATCHDOG_EXIT_CODE = 4
+
+
+def dump_all_stacks(stream=None) -> None:
+    """Write every live Python thread's stack to ``stream`` (stderr)."""
+    stream = stream if stream is not None else sys.stderr
+    frames = sys._current_frames()
+    threads = {t.ident: t for t in threading.enumerate()}
+    for ident, frame in frames.items():
+        thread = threads.get(ident)
+        name = thread.name if thread is not None else f"thread-{ident}"
+        print(f"--- stack of {name} (ident={ident}) ---", file=stream)
+        for line in traceback.format_stack(frame):
+            stream.write(line)
+    stream.flush()
+
+
+class HangWatchdog:
+    """Fires when no :meth:`beat` arrives within ``deadline_s``.
+
+    ``ledger``: optional :class:`~sav_tpu.obs.goodput.GoodputLedger`
+    whose summary is dumped alongside the stacks (where the time went
+    before the hang). ``exit_fn``/``stream`` are injectable for tests —
+    production uses ``os._exit`` so a wedged main thread cannot swallow
+    the abort.
+    """
+
+    def __init__(
+        self,
+        deadline_s: float,
+        *,
+        ledger=None,
+        tag: str = "watchdog",
+        exit_code: int = WATCHDOG_EXIT_CODE,
+        exit_fn: Optional[Callable[[int], None]] = None,
+        stream=None,
+        poll_s: Optional[float] = None,
+    ):
+        if deadline_s <= 0:
+            raise ValueError(f"deadline_s must be positive, got {deadline_s}")
+        self.deadline_s = deadline_s
+        self.ledger = ledger
+        self.tag = tag
+        self.exit_code = exit_code
+        self._exit_fn = exit_fn if exit_fn is not None else os._exit
+        self._stream = stream
+        self._poll_s = poll_s if poll_s is not None else min(deadline_s / 4, 5.0)
+        self._last_beat = time.monotonic()
+        self._stop = threading.Event()
+        self.fired = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def beat(self) -> None:
+        """Mark progress; call once per completed step/loop iteration."""
+        self._last_beat = time.monotonic()
+
+    def start(self) -> "HangWatchdog":
+        if self._thread is not None:
+            return self
+        self.beat()  # the deadline counts from start, not construction
+        self._thread = threading.Thread(
+            target=self._run, name=f"{self.tag}-thread", daemon=True
+        )
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        """Disarm (normal shutdown, eval/checkpoint-free exit paths)."""
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=2 * self._poll_s)
+            self._thread = None
+
+    def __enter__(self) -> "HangWatchdog":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.stop()
+
+    def _run(self) -> None:
+        while not self._stop.wait(self._poll_s):
+            silent_s = time.monotonic() - self._last_beat
+            if silent_s >= self.deadline_s:
+                self._fire(silent_s)
+                return
+
+    def _fire(self, silent_s: float) -> None:
+        stream = self._stream if self._stream is not None else sys.stderr
+        print(
+            f"{self.tag}: HANG — no step completed in {silent_s:.0f}s "
+            f"(deadline {self.deadline_s:.0f}s); dumping stacks and "
+            f"aborting with exit {self.exit_code}",
+            file=stream,
+        )
+        try:
+            dump_all_stacks(stream)
+            if self.ledger is not None:
+                print(
+                    f"{self.tag}: goodput ledger at hang: "
+                    + json.dumps(self.ledger.summary()),
+                    file=stream,
+                )
+        except Exception as e:  # diagnostics must not mask the abort
+            print(f"{self.tag}: dump failed: {e!r}", file=stream)
+        stream.flush()
+        self.fired.set()
+        self._exit_fn(self.exit_code)

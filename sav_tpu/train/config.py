@@ -117,6 +117,23 @@ class TrainConfig:
     profile_num_steps: int = 5
     debug_nans: bool = False
 
+    # Run telemetry (sav_tpu.obs; docs/observability.md).
+    # Sink directory for spans.trace.json / goodput.json (None falls back
+    # to checkpoint_dir, then cwd).
+    log_dir: Optional[str] = None
+    # In-jit optimization diagnostics folded into the step metrics
+    # (param/update norms, update-to-param ratio, per-layer-group grad
+    # norms, nonfinite counts) plus HBM + retrace telemetry at log time.
+    # Rides the existing per-log device_get — zero extra transfers.
+    diagnostics: bool = False
+    # Host-side span tracer around fit()'s phases; writes a
+    # Chrome-trace-event JSON (Perfetto-loadable) to <log_dir>.
+    trace_spans: bool = False
+    # Steady-state hang watchdog: abort with exit 4 + full stack dump when
+    # no step completes within this many seconds (None disables). Armed
+    # after the first step so compile time cannot false-fire it.
+    watchdog_secs: Optional[float] = None
+
     @property
     def steps_per_epoch(self) -> int:
         return self.num_train_images // self.global_batch_size

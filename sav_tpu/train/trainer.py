@@ -16,6 +16,8 @@ called it).
 
 from __future__ import annotations
 
+import json
+import os
 import time
 from typing import Any, Iterator, Optional
 
@@ -26,6 +28,10 @@ import optax
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from sav_tpu.models import create_model
+from sav_tpu.obs.diagnostics import diagnostics_metrics
+from sav_tpu.obs.goodput import GoodputLedger
+from sav_tpu.obs.memory import RetraceCounter, hbm_stats
+from sav_tpu.obs.spans import SpanTracer
 from sav_tpu.parallel.mesh import batch_axes, create_mesh
 from sav_tpu.parallel.sharding import param_shardings
 from sav_tpu.train.checkpoint import Checkpointer
@@ -188,6 +194,8 @@ class Trainer:
         self._train_step = jax.jit(self._train_step_impl, donate_argnums=(0,))
         self._train_many = jax.jit(self._train_many_impl, donate_argnums=(0,))
         self._eval_step = jax.jit(self._eval_step_impl)
+        # Goodput ledger summary of the most recent fit() (sav_tpu.obs).
+        self.last_goodput: Optional[dict] = None
 
     # ------------------------------------------------------------------ init
 
@@ -529,6 +537,15 @@ class Trainer:
             "grad_norm": optax.global_norm(grads),
             "aux_loss": aux_loss,
         }
+        if self.config.diagnostics:
+            # In-jit diagnostics (sav_tpu.obs.diagnostics): computed on
+            # device, returned with the step metrics, so they ride the
+            # per-log device_get with zero extra transfers.
+            metrics.update(
+                diagnostics_metrics(
+                    grads=grads, params=state.params, updates=updates
+                )
+            )
         return new_state, metrics
 
     def _train_many_impl(self, state: TrainState, batches: dict, rng: jax.Array):
@@ -707,12 +724,43 @@ class Trainer:
             (fixes the reference's exhausted-generator eval bug,
             train.py:239-250 / SURVEY.md §2.9 #21).
           log_fn: callable(dict) for metrics (host-side, outside jit).
+
+        Run telemetry (sav_tpu.obs, docs/observability.md): every run keeps
+        a goodput ledger (compile/step/input-wait/eval/checkpoint/stall
+        buckets, written to <log_dir>/goodput.json and exposed as
+        ``self.last_goodput``); ``config.trace_spans`` additionally records
+        host-side spans around each phase into a Perfetto-loadable
+        <log_dir>/spans.trace.json, and ``config.watchdog_secs`` arms a
+        hang watchdog that aborts with exit 4 + stack dump when no step
+        completes in time.
         """
         cfg = self.config
         num_steps = num_steps if num_steps is not None else cfg.total_steps
         state = state if state is not None else self.restore_or_init()
         rng = jax.random.PRNGKey(cfg.seed + 1)
         history: list[dict] = []
+        obs_dir = cfg.log_dir or cfg.checkpoint_dir
+        # Telemetry files are written by process 0 only — multi-host runs
+        # share --log-dir (the rsync/report workflow) and concurrent
+        # writers would clobber each other.
+        obs_writer = jax.process_index() == 0
+        tracer = SpanTracer(
+            os.path.join(obs_dir or ".", "spans.trace.json")
+            if cfg.trace_spans and obs_writer else None
+        )
+        ledger = GoodputLedger()
+        retraces = RetraceCounter(self._train_step) if cfg.diagnostics else None
+        watchdog = None
+        if cfg.watchdog_secs:
+            from sav_tpu.obs.watchdog import HangWatchdog
+
+            # NOTE: the deadline must exceed the longest legitimate gap
+            # between completed steps — an eval pass or checkpoint save
+            # counts one beat at its end, so size watchdog_secs above the
+            # slowest of those, not just above the step time.
+            watchdog = HangWatchdog(
+                cfg.watchdog_secs, ledger=ledger, tag="train-watchdog"
+            )
         # When MFU can be reported (known chip peak), the step is compiled
         # ahead-of-time ONCE and the loop calls the compiled executable —
         # cost analysis comes from the same compilation, not a second one
@@ -730,8 +778,13 @@ class Trainer:
         prof_start = start_step + cfg.profile_start_step
         prof_stop = prof_start + max(cfg.profile_num_steps, 1)
         profiling = False
+        # Wall time of the current logging window attributable to training
+        # compute (dispatch + log sync); attributed to the ledger's step /
+        # stall buckets at each log boundary (per-window anomaly flags).
+        window_s = 0.0
+        data_iter = iter(train_iter)
         try:
-            for step, batch in zip(range(start_step, num_steps), train_iter):
+            for step in range(start_step, num_steps):
                 if cfg.profile_dir is not None:
                     # Steps dispatch asynchronously: sync the device at both
                     # window edges so the trace covers exactly the intended
@@ -744,26 +797,57 @@ class Trainer:
                         jax.block_until_ready(state)
                         profiler.stop_trace()
                         profiling = False
-                sharded = self.shard_batch(batch)
+                with tracer.span("batch_fetch", step=step + 1), \
+                        ledger.measure("input_wait"):
+                    try:
+                        batch = next(data_iter)
+                    except StopIteration:
+                        break
+                with tracer.span("shard_batch", step=step + 1), \
+                        ledger.measure("input_wait"):
+                    sharded = self.shard_batch(batch)
                 if peak_flops and compiled_step is None:
                     from sav_tpu.utils.flops import compiled_flops
 
-                    compiled_step = self._train_step.lower(
-                        state, sharded, rng
-                    ).compile()
-                    step_flops = compiled_flops(compiled_step)
+                    with tracer.span("compile"), ledger.measure("compile"):
+                        compiled_step = self._train_step.lower(
+                            state, sharded, rng
+                        ).compile()
+                        step_flops = compiled_flops(compiled_step)
                     # Don't let compile time pollute the first throughput
                     # and MFU window.
                     t_last = time.time()
                 step_fn = compiled_step if compiled_step is not None else self._train_step
-                state, metrics = step_fn(state, sharded, rng)
+                t_step = time.perf_counter()
+                with tracer.span("step_dispatch", step=step + 1):
+                    state, metrics = step_fn(state, sharded, rng)
+                dispatch_s = time.perf_counter() - t_step
+                if step == start_step and compiled_step is None:
+                    # The first jit dispatch blocks through trace+compile;
+                    # bucket it as compile (it carries one step of device
+                    # time too — noise next to a multi-minute relay
+                    # compile).
+                    ledger.account("compile", dispatch_s)
+                else:
+                    window_s += dispatch_s
+                if retraces is not None and step == start_step:
+                    # The first dispatch's trace is expected compilation,
+                    # not a re-trace; swallow it so retraces=0 on a
+                    # healthy run's first log window.
+                    retraces.delta()
                 if cfg.debug_nans:
                     assert_all_finite(metrics, f"metrics at step {step + 1}")
                 if (step + 1) % cfg.log_every_steps == 0 or step + 1 == num_steps:
-                    m = {k: float(v) for k, v in jax.device_get(metrics).items()}
+                    t_sync = time.perf_counter()
+                    with tracer.span("log_sync", step=step + 1):
+                        m = {k: float(v) for k, v in jax.device_get(metrics).items()}
+                    window_s += time.perf_counter() - t_sync
                     now = time.time()
                     m["step"] = step + 1
                     steps_since = step + 1 - last_logged_step
+                    if ledger.note_window(steps_since, window_s, step=step + 1):
+                        tracer.instant("stall_anomaly", step=step + 1)
+                    window_s = 0.0
                     m["images_per_sec"] = (
                         cfg.global_batch_size * steps_since / max(now - t_last, 1e-9)
                     )
@@ -773,6 +857,13 @@ class Trainer:
                         # the north star in its own unit (BASELINE.md).
                         step_s = max(now - t_last, 1e-9) / max(steps_since, 1)
                         m["mfu"] = step_flops / step_s / peak_flops
+                    if cfg.diagnostics:
+                        # Host-side telemetry sampled only at log boundaries:
+                        # HBM occupancy ({} on backends without memory_stats)
+                        # and silent-recompilation detection.
+                        m.update(hbm_stats())
+                        if retraces is not None:
+                            m["retraces"] = float(retraces.delta())
                     t_last = now
                     last_logged_step = step + 1
                     history.append(m)
@@ -782,7 +873,9 @@ class Trainer:
                 if epoch_done:
                     epoch = (step + 1) // cfg.steps_per_epoch
                     if eval_iter_fn is not None and epoch % cfg.eval_every_epochs == 0:
-                        em = self.evaluate(state, eval_iter_fn())
+                        with tracer.span("eval", epoch=epoch), \
+                                ledger.measure("eval"):
+                            em = self.evaluate(state, eval_iter_fn())
                         em["step"] = step + 1
                         history.append(em)
                         if log_fn is not None:
@@ -791,17 +884,64 @@ class Trainer:
                         self.checkpointer is not None
                         and epoch % cfg.checkpoint_every_epochs == 0
                     ):
-                        self.checkpointer.save(step + 1, state)
+                        with tracer.span("checkpoint", step=step + 1), \
+                                ledger.measure("checkpoint"):
+                            self.checkpointer.save(step + 1, state)
                         last_saved_step = step + 1
                     # Reset the throughput window so eval/checkpoint wall time
                     # doesn't deflate the next logged images_per_sec.
                     t_last = time.time()
-                    last_logged_step = step + 1
+                    if step + 1 != last_logged_step:
+                        # Steps since the last log boundary haven't been
+                        # noted yet (steps_per_epoch not a multiple of
+                        # log_every_steps): book their window now so the
+                        # ledger's per-step medians stay honest.
+                        if ledger.note_window(
+                            step + 1 - last_logged_step, window_s,
+                            step=step + 1,
+                        ):
+                            tracer.instant("stall_anomaly", step=step + 1)
+                        window_s = 0.0
+                        last_logged_step = step + 1
+                if watchdog is not None:
+                    # Armed only after the first completed step: compile
+                    # belongs to backend_probe's startup regime, steady
+                    # state is the watchdog's.
+                    if step == start_step:
+                        watchdog.start()
+                    else:
+                        watchdog.beat()
+            if window_s:
+                # StopIteration cut the run between log boundaries.
+                ledger.account("step", window_s)
+            if watchdog is not None:
+                # The step loop is done; the final save/wait below can
+                # legitimately exceed the steady-state deadline on a slow
+                # relay, and firing there would corrupt the checkpoint.
+                watchdog.stop()
+            if self.checkpointer is not None:
+                if last_saved_step != num_steps:
+                    with tracer.span("checkpoint", step=num_steps), \
+                            ledger.measure("checkpoint"):
+                        self.checkpointer.save(num_steps, state)
+                with ledger.measure("checkpoint"):
+                    self.checkpointer.wait()
         finally:
+            if watchdog is not None:
+                watchdog.stop()
             if profiling:
                 profiler.stop_trace()
-        if self.checkpointer is not None:
-            if last_saved_step != num_steps:
-                self.checkpointer.save(num_steps, state)
-            self.checkpointer.wait()
+            tracer.write()
+        self.last_goodput = ledger.summary()
+        if obs_dir is not None and obs_writer:
+            os.makedirs(obs_dir, exist_ok=True)
+            with open(os.path.join(obs_dir, "goodput.json"), "w") as f:
+                json.dump(self.last_goodput, f, indent=2)
+        goodput_record = {
+            "step": int(jax.device_get(state.step)),
+            **ledger.flat_metrics(),
+        }
+        history.append(goodput_record)
+        if log_fn is not None:
+            log_fn(goodput_record)
         return state, history

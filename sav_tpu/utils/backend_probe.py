@@ -8,12 +8,15 @@ hangs uninterruptibly. These helpers probe from a **subprocess** with a
 timeout, so callers can degrade a transient outage into a late start or a
 prompt, clearly-labeled abort instead of a silently hung job.
 
-Counterpart of the reference's startup failure-detection (its trainer
-surfaces NCCL init errors and aborts; /root/reference/train.py:77-109
-context) — on a tunneled TPU the equivalent guard has to be an external
-probe because the in-process path cannot time out.
+This is new behavior, not reference parity: the reference assumes a
+healthy single-host device and has no startup failure-detection at all —
+if backend init hung it would simply hang. The tunneled-relay failure
+mode observed here (ADVICE.md r5) forces the guard, and it has to be an
+external subprocess probe because the in-process path cannot time out.
 
-Used by ``bench.py --backend-wait`` and ``train.py --backend-wait``.
+Used by ``bench.py --backend-wait`` and ``train.py --backend-wait``; the
+steady-state counterpart (a run that hangs *after* starting) is
+``sav_tpu.obs.watchdog``.
 """
 
 from __future__ import annotations
@@ -24,10 +27,13 @@ import sys
 import time
 
 # device_get of a computed value, not block_until_ready — the relay can ack
-# transfers early (see docs/benchmarking.md).
+# transfers early (see docs/benchmarking.md). The platform is printed
+# behind a sentinel prefix so banners/warnings a plugin emits on stdout
+# can never be misread as a reachable platform.
+_PROBE_SENTINEL = "PROBE_PLATFORM="
 _PROBE_SRC = """
 import jax, jax.numpy as jnp
-print(jax.devices()[0].platform)
+print("PROBE_PLATFORM=" + jax.devices()[0].platform)
 print(jax.device_get((jnp.ones((128, 128), jnp.bfloat16)
                       @ jnp.ones((128, 128), jnp.bfloat16)).sum()))
 """
@@ -58,7 +64,13 @@ def probe_backend(timeout_s: float):
         return None
     if proc.returncode != 0:
         return None
-    platform = proc.stdout.split()[0] if proc.stdout.split() else None
+    platform = None
+    for line in proc.stdout.splitlines():
+        if line.startswith(_PROBE_SENTINEL):
+            platform = line[len(_PROBE_SENTINEL):].strip() or None
+            break
+    if platform is None:  # sentinel absent: stdout was banners, not a probe
+        return None
     if platform == "cpu" and accelerator_expected():
         return None
     return platform
@@ -73,9 +85,10 @@ def wait_for_backend(deadline_s: float = 600.0, poll_s: float = 30.0,
     the relay is truly wedged). CPU-only environments skip the probe and
     return 'cpu'; healthy accelerator environments pay one subprocess JAX
     init (~10-30 s — noise next to the multi-minute relay compile).
-    Per-probe timeouts are clamped to the remaining deadline so the total
-    wait honors ``deadline_s`` even for small values. Logs to stderr under
-    ``tag``.
+    Per-probe timeouts are clamped to the remaining deadline, and the wait
+    only gives up once ~1 s of budget remains — the last probe runs with
+    whatever is left rather than abandoning up to ``poll_s`` unused
+    (ADVICE.md r5). Logs to stderr under ``tag``.
     """
     if not accelerator_expected():
         return "cpu"
@@ -94,7 +107,7 @@ def wait_for_backend(deadline_s: float = 600.0, poll_s: float = 30.0,
                 )
             return platform
         remaining = deadline_s - (time.monotonic() - t0)
-        if remaining <= poll_s:
+        if remaining <= 1.0:
             print(
                 f"{tag}: backend unreachable after "
                 f"{time.monotonic() - t0:.0f}s ({attempt} probes); "
@@ -102,12 +115,15 @@ def wait_for_backend(deadline_s: float = 600.0, poll_s: float = 30.0,
                 file=sys.stderr,
             )
             return None
+        # Sleep at most poll_s, but never past the point where only the
+        # final clamped probe's budget remains.
+        sleep_s = min(poll_s, max(remaining - 1.0, 0.0))
         print(
             f"{tag}: backend probe {attempt} failed at "
-            f"{time.monotonic() - t0:.0f}s; retrying in {poll_s:.0f}s",
+            f"{time.monotonic() - t0:.0f}s; retrying in {sleep_s:.0f}s",
             file=sys.stderr,
         )
-        time.sleep(poll_s)
+        time.sleep(sleep_s)
 
 
 def require_backend_or_exit(deadline_s: float, tag: str, exit_code: int = 3):

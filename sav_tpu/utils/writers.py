@@ -35,11 +35,20 @@ class JsonlWriter:
 
     def write(self, step: int, metrics: Mapping[str, float]) -> None:
         rec = {"step": int(step)}
-        rec.update({k: float(v) for k, v in metrics.items()})
+        for k, v in metrics.items():
+            if k == "step":
+                continue  # the positional step wins; don't float-cast it
+            try:
+                rec[k] = float(v)
+            except (TypeError, ValueError):
+                # Non-scalar payloads (e.g. a goodput summary dict) pass
+                # through as-is — jsonl is the one sink that can hold them.
+                rec[k] = v
         self._f.write(json.dumps(rec) + "\n")
 
     def close(self) -> None:
-        self._f.close()
+        if not self._f.closed:
+            self._f.close()
 
 
 class LoggingWriter:
@@ -142,5 +151,13 @@ class MultiWriter:
             w.write(step, metrics)
 
     def close(self) -> None:
+        # Close every sink even if one raises (a wandb network error must
+        # not leave the jsonl file unflushed); re-raise the first failure.
+        errors: list[Exception] = []
         for w in self._writers:
-            w.close()
+            try:
+                w.close()
+            except Exception as e:
+                errors.append(e)
+        if errors:
+            raise errors[0]

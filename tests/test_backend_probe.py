@@ -73,20 +73,77 @@ def test_require_backend_or_exit_abort_contract(monkeypatch):
     assert bp.require_backend_or_exit(5.0, tag="test") == "axon"
 
 
-def test_cpu_platform_counts_as_unreachable_when_accel_expected(monkeypatch):
-    _clear(monkeypatch)
-    monkeypatch.setenv("PALLAS_AXON_POOL_IPS", "127.0.0.1")
+def _fake_probe_run(monkeypatch, stdout, returncode=0):
     calls = []
 
     def fake_run(cmd, **kw):
         calls.append(cmd)
 
         class P:
-            returncode = 0
-            stdout = "cpu\n16384.0\n"
+            pass
 
+        P.returncode = returncode
+        P.stdout = stdout
         return P()
 
     monkeypatch.setattr(bp.subprocess, "run", fake_run)
+    return calls
+
+
+def test_cpu_platform_counts_as_unreachable_when_accel_expected(monkeypatch):
+    _clear(monkeypatch)
+    monkeypatch.setenv("PALLAS_AXON_POOL_IPS", "127.0.0.1")
+    calls = _fake_probe_run(monkeypatch, "PROBE_PLATFORM=cpu\n16384.0\n")
     assert bp.probe_backend(timeout_s=5.0) is None
     assert calls
+
+
+def test_probe_parses_sentinel_not_first_token(monkeypatch):
+    """A plugin banner on stdout must not be misread as a platform."""
+    _clear(monkeypatch)
+    _fake_probe_run(
+        monkeypatch,
+        "axon-plugin: dialing relay pool...\n"
+        "PROBE_PLATFORM=axon\n16384.0\n",
+    )
+    assert bp.probe_backend(timeout_s=5.0) == "axon"
+
+
+def test_probe_without_sentinel_is_unreachable(monkeypatch):
+    """Stdout that is only banners (no sentinel) is not a working probe —
+    the old first-token parse would have reported 'warning:' as a
+    reachable platform."""
+    _clear(monkeypatch)
+    _fake_probe_run(monkeypatch, "warning: something chatty\naxon\n")
+    assert bp.probe_backend(timeout_s=5.0) is None
+
+
+def test_wait_spends_full_deadline(monkeypatch):
+    """The wait only gives up when ~1s of budget remains: with a 10s
+    deadline and 4s poll, the old `remaining <= poll_s` bail-out stopped
+    after ~one sleep; now probes keep coming until the budget is gone."""
+    _clear(monkeypatch)
+    monkeypatch.setenv("PALLAS_AXON_POOL_IPS", "127.0.0.1")
+    clock = {"t": 0.0}
+    probes = []
+
+    monkeypatch.setattr(bp.time, "monotonic", lambda: clock["t"])
+    monkeypatch.setattr(
+        bp.time, "sleep", lambda s: clock.__setitem__("t", clock["t"] + s)
+    )
+
+    def probe(timeout_s):
+        probes.append((clock["t"], timeout_s))
+        clock["t"] += min(timeout_s, 0.5)  # each probe fails fast
+        return None
+
+    monkeypatch.setattr(bp, "probe_backend", probe)
+    assert bp.wait_for_backend(deadline_s=10.0, poll_s=4.0) is None
+    # Probes at ~0, ~4.5, ~9: the third lands inside the final poll window
+    # the old logic abandoned.
+    assert len(probes) >= 3
+    assert probes[-1][0] > 10.0 - 4.0  # a probe ran inside the last poll_s
+    assert clock["t"] >= 9.0  # (almost) the whole deadline was spent
+    # And every probe timeout stayed within the remaining budget.
+    for start, timeout_s in probes:
+        assert timeout_s <= max(10.0 - start, 1.0) + 1e-9

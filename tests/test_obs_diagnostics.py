@@ -1,0 +1,92 @@
+"""In-jit diagnostics (sav_tpu/obs/diagnostics.py): values on tiny trees,
+jit-compatibility, and the per-layer-group split."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from sav_tpu.obs.diagnostics import (
+    diagnostics_metrics,
+    grad_group_norms,
+    nonfinite_count,
+)
+
+
+def _tree(scale=1.0):
+    return {
+        "encoder_block_0": {"w": jnp.full((3, 3), scale), "b": jnp.zeros((3,))},
+        "head": {"w": jnp.full((2,), 2.0 * scale)},
+    }
+
+
+def test_nonfinite_count_zero_on_clean_tree():
+    assert int(nonfinite_count(_tree())) == 0
+
+
+def test_nonfinite_count_counts_elements_not_leaves():
+    tree = _tree()
+    tree["head"]["w"] = jnp.array([jnp.nan, jnp.inf])
+    tree["encoder_block_0"]["b"] = jnp.array([1.0, -jnp.inf, 0.0])
+    assert int(nonfinite_count(tree)) == 3
+
+
+def test_nonfinite_count_ignores_int_leaves():
+    assert int(nonfinite_count({"step": jnp.array(7, jnp.int32)})) == 0
+
+
+def test_group_norms_split_by_top_level_module():
+    grads = _tree()
+    norms = grad_group_norms(grads)
+    assert set(norms) == {"grad_norm/encoder_block_0", "grad_norm/head"}
+    np.testing.assert_allclose(
+        float(norms["grad_norm/encoder_block_0"]), 3.0, rtol=1e-6
+    )  # nine 1.0s
+    np.testing.assert_allclose(
+        float(norms["grad_norm/head"]), np.sqrt(8.0), rtol=1e-6
+    )
+
+
+def test_diagnostics_values_match_manual_norms():
+    params = _tree(1.0)
+    grads = _tree(0.5)
+    updates = jax.tree.map(lambda g: -0.1 * g, grads)
+    m = diagnostics_metrics(grads=grads, params=params, updates=updates)
+    leaves = np.concatenate([np.ravel(x) for x in jax.tree.leaves(params)])
+    p_norm = np.linalg.norm(leaves)
+    np.testing.assert_allclose(float(m["param_norm"]), p_norm, rtol=1e-6)
+    np.testing.assert_allclose(
+        float(m["update_to_param_ratio"]),
+        float(m["update_norm"]) / p_norm,
+        rtol=1e-5,
+    )
+    assert int(m["nonfinite_grads"]) == 0
+    assert int(m["nonfinite_params"]) == 0
+    assert "grad_norm/head" in m
+
+
+def test_diagnostics_runs_under_jit():
+    @jax.jit
+    def f(params, grads, updates):
+        return dict(
+            diagnostics_metrics(grads=grads, params=params, updates=updates)
+        )
+
+    out = f(_tree(), _tree(0.5), _tree(0.01))
+    assert float(out["param_norm"]) > 0.0
+    assert np.isfinite(float(out["update_to_param_ratio"]))
+
+
+def test_per_group_off_drops_group_keys():
+    m = diagnostics_metrics(
+        grads=_tree(), params=_tree(), updates=_tree(), per_group=False
+    )
+    assert not any(k.startswith("grad_norm/") for k in m)
+
+
+@pytest.mark.parametrize("bad", [jnp.nan, jnp.inf])
+def test_diagnostics_flags_nonfinite_grads(bad):
+    grads = _tree()
+    grads["head"]["w"] = jnp.array([bad, 1.0])
+    m = diagnostics_metrics(grads=grads, params=_tree(), updates=_tree())
+    assert int(m["nonfinite_grads"]) == 1

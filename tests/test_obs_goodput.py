@@ -1,0 +1,120 @@
+"""Goodput ledger (sav_tpu/obs/goodput.py): bucket accounting, the
+buckets-sum-to-wall invariant, and per-window stall anomaly detection —
+all on an injected fake clock so the tests are deterministic."""
+
+import pytest
+
+from sav_tpu.obs.goodput import BUCKETS, GoodputLedger
+
+
+class FakeClock:
+    def __init__(self):
+        self.t = 100.0
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, s):
+        self.t += s
+
+
+@pytest.fixture
+def clock():
+    return FakeClock()
+
+
+def test_unknown_bucket_rejected(clock):
+    ledger = GoodputLedger(clock=clock)
+    with pytest.raises(KeyError):
+        ledger.account("naps", 1.0)
+
+
+def test_measure_accounts_wall_time(clock):
+    ledger = GoodputLedger(clock=clock)
+    with ledger.measure("input_wait"):
+        clock.advance(2.5)
+    assert ledger.summary()["buckets_s"]["input_wait"] == pytest.approx(2.5)
+
+
+def test_buckets_sum_to_wall_time(clock):
+    ledger = GoodputLedger(clock=clock)
+    with ledger.measure("compile"):
+        clock.advance(30.0)
+    for _ in range(10):
+        with ledger.measure("input_wait"):
+            clock.advance(0.5)
+        clock.advance(1.0)  # unaccounted loop overhead
+        clock.advance(4.0)  # the window's step time...
+        ledger.note_window(10, 4.0)  # ...attributed at the log boundary
+    with ledger.measure("eval"):
+        clock.advance(8.0)
+    with ledger.measure("checkpoint"):
+        clock.advance(3.0)
+    s = ledger.summary()
+    total = sum(s["buckets_s"].values())
+    assert total == pytest.approx(s["wall_s"], rel=0.05)
+    # 10 windows x 1.0s advanced outside any bucket -> the residual.
+    assert s["buckets_s"]["other"] == pytest.approx(10.0, rel=1e-6)
+    assert s["steps"] == 100
+
+
+def test_fractions_and_goodput_fraction(clock):
+    ledger = GoodputLedger(clock=clock)
+    with ledger.measure("step"):
+        clock.advance(75.0)
+    with ledger.measure("compile"):
+        clock.advance(25.0)
+    s = ledger.summary()
+    assert s["goodput_fraction"] == pytest.approx(0.75)
+    assert s["fractions"]["compile"] == pytest.approx(0.25)
+    assert set(s["buckets_s"]) == set(BUCKETS)
+
+
+def test_stall_window_is_flagged_and_split(clock):
+    ledger = GoodputLedger(clock=clock, stall_factor=5.0)
+    for i in range(5):
+        assert not ledger.note_window(10, 1.0, step=(i + 1) * 10)
+    # 10x the 0.1 s/step median: anomalous. Expected share -> step,
+    # excess -> stall.
+    assert ledger.note_window(10, 10.0, step=60)
+    s = ledger.summary()
+    assert s["num_anomalies"] == 1
+    (anomaly,) = s["anomalies"]
+    assert anomaly["step"] == 60
+    assert anomaly["slowdown"] == pytest.approx(10.0)
+    assert s["buckets_s"]["stall"] == pytest.approx(9.0)
+    assert s["buckets_s"]["step"] == pytest.approx(5 * 1.0 + 1.0)
+
+
+def test_stalled_window_does_not_poison_median(clock):
+    ledger = GoodputLedger(clock=clock, stall_factor=5.0)
+    for _ in range(4):
+        ledger.note_window(10, 1.0)
+    ledger.note_window(10, 100.0)  # massive stall
+    # Back to normal: must NOT be flagged as anomalously *fast* or slow —
+    # the stalled window stayed out of the rolling median.
+    assert not ledger.note_window(10, 1.0)
+    assert ledger.summary()["median_step_s"] == pytest.approx(0.1)
+
+
+def test_first_window_never_anomalous(clock):
+    ledger = GoodputLedger(clock=clock)
+    assert not ledger.note_window(10, 1000.0)
+    assert ledger.summary()["num_anomalies"] == 0
+
+
+def test_flat_metrics_are_scalar_floats(clock):
+    ledger = GoodputLedger(clock=clock)
+    with ledger.measure("step"):
+        clock.advance(1.0)
+    flat = ledger.flat_metrics()
+    assert flat["goodput/step_s"] == pytest.approx(1.0)
+    for key, value in flat.items():
+        assert key.startswith("goodput/")
+        assert isinstance(value, float) or isinstance(value, int)
+
+
+def test_zero_step_window_ignored(clock):
+    ledger = GoodputLedger(clock=clock)
+    assert not ledger.note_window(0, 5.0)
+    assert ledger.summary()["steps"] == 0

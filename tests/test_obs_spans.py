@@ -1,0 +1,110 @@
+"""Span tracer (sav_tpu/obs/spans.py): Chrome-trace-event JSON
+well-formedness and the disabled-tracer no-op contract."""
+
+import json
+import threading
+
+from sav_tpu.obs.spans import SpanTracer
+
+
+def test_disabled_tracer_is_noop(tmp_path):
+    tracer = SpanTracer(None)
+    with tracer.span("anything"):
+        pass
+    tracer.instant("marker")
+    assert tracer.write() is None
+    assert not tracer.enabled
+
+
+def test_trace_file_is_perfetto_loadable_json(tmp_path):
+    path = str(tmp_path / "spans.trace.json")
+    tracer = SpanTracer(path)
+    with tracer.span("batch_fetch", step=1):
+        pass
+    with tracer.span("step_dispatch", step=1):
+        with tracer.span("inner"):
+            pass
+    tracer.instant("stall_anomaly", step=1)
+    assert tracer.write() == path
+
+    with open(path) as f:
+        doc = json.load(f)
+    events = doc["traceEvents"]
+    complete = [e for e in events if e.get("ph") == "X"]
+    assert {e["name"] for e in complete} == {
+        "batch_fetch", "step_dispatch", "inner"
+    }
+    for e in complete:
+        # The Trace Event Format's required complete-event fields.
+        assert set(e) >= {"name", "ph", "ts", "dur", "pid", "tid"}
+        assert e["dur"] >= 0
+        assert e["ts"] >= 0
+    instants = [e for e in events if e.get("ph") == "i"]
+    assert instants and instants[0]["name"] == "stall_anomaly"
+    assert instants[0]["args"] == {"step": 1}
+
+
+def test_nested_span_ordering(tmp_path):
+    path = str(tmp_path / "t.json")
+    tracer = SpanTracer(path)
+    with tracer.span("outer"):
+        with tracer.span("inner"):
+            pass
+    tracer.write()
+    with open(path) as f:
+        events = {
+            e["name"]: e for e in json.load(f)["traceEvents"]
+            if e.get("ph") == "X"
+        }
+    outer, inner = events["outer"], events["inner"]
+    assert outer["ts"] <= inner["ts"]
+    assert outer["ts"] + outer["dur"] >= inner["ts"] + inner["dur"]
+
+
+def test_span_records_on_exception(tmp_path):
+    path = str(tmp_path / "t.json")
+    tracer = SpanTracer(path)
+    try:
+        with tracer.span("failing"):
+            raise RuntimeError("boom")
+    except RuntimeError:
+        pass
+    tracer.write()
+    with open(path) as f:
+        names = {
+            e["name"] for e in json.load(f)["traceEvents"]
+            if e.get("ph") == "X"
+        }
+    assert "failing" in names
+
+
+def test_concurrent_spans_are_thread_safe(tmp_path):
+    path = str(tmp_path / "t.json")
+    tracer = SpanTracer(path)
+
+    def worker():
+        for _ in range(50):
+            with tracer.span("w"):
+                pass
+
+    threads = [threading.Thread(target=worker) for _ in range(4)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    tracer.write()
+    with open(path) as f:
+        events = [
+            e for e in json.load(f)["traceEvents"] if e.get("ph") == "X"
+        ]
+    assert len(events) == 200
+
+
+def test_write_creates_parent_dirs(tmp_path):
+    path = str(tmp_path / "deep" / "nested" / "spans.trace.json")
+    tracer = SpanTracer(path)
+    with tracer.span("s"):
+        pass
+    assert tracer.write() == path
+    with open(path) as f:
+        json.load(f)

@@ -1,0 +1,117 @@
+"""Hang watchdog (sav_tpu/obs/watchdog.py): a stalled step triggers the
+stack dump + labeled exit code; a normally-beating run never fires.
+The exit function is injected so the suite survives the 'abort'."""
+
+import io
+import threading
+import time
+
+import pytest
+
+from sav_tpu.obs.goodput import GoodputLedger
+from sav_tpu.obs.watchdog import WATCHDOG_EXIT_CODE, HangWatchdog, dump_all_stacks
+
+
+class FakeExit:
+    def __init__(self):
+        self.codes = []
+        self.called = threading.Event()
+
+    def __call__(self, code):
+        self.codes.append(code)
+        self.called.set()
+
+
+def test_exit_code_contract_distinct_from_backend_probe():
+    # backend_probe aborts startup with 3; the watchdog owns 4. Wrapper
+    # scripts key on both — pin the constant.
+    assert WATCHDOG_EXIT_CODE == 4
+
+
+def test_stalled_step_fires_with_stacks_and_labeled_exit():
+    exit_fn = FakeExit()
+    stream = io.StringIO()
+    ledger = GoodputLedger()
+    with ledger.measure("step"):
+        pass
+    watchdog = HangWatchdog(
+        0.2, ledger=ledger, tag="test-watchdog", exit_fn=exit_fn,
+        stream=stream, poll_s=0.05,
+    )
+    watchdog.start()
+    try:
+        # A deliberately-stalled step: never beat.
+        assert exit_fn.called.wait(timeout=5.0), "watchdog never fired"
+    finally:
+        watchdog.stop()
+    assert exit_fn.codes == [WATCHDOG_EXIT_CODE]
+    output = stream.getvalue()
+    assert "test-watchdog: HANG" in output
+    assert f"exit {WATCHDOG_EXIT_CODE}" in output
+    # The stack dump must include this (the stalled main) thread's frames.
+    assert "stack of MainThread" in output
+    assert "test_stalled_step_fires" in output
+    # ... and the goodput ledger snapshot.
+    assert "goodput ledger at hang" in output
+    assert '"buckets_s"' in output
+
+
+def test_no_false_fire_on_normal_run():
+    exit_fn = FakeExit()
+    watchdog = HangWatchdog(
+        0.3, tag="test-watchdog", exit_fn=exit_fn, poll_s=0.05
+    )
+    watchdog.start()
+    try:
+        deadline = time.monotonic() + 1.0
+        while time.monotonic() < deadline:
+            watchdog.beat()  # a healthy step loop
+            time.sleep(0.05)
+    finally:
+        watchdog.stop()
+    assert not exit_fn.called.is_set()
+    assert not watchdog.fired.is_set()
+
+
+def test_stop_disarms_before_deadline():
+    exit_fn = FakeExit()
+    watchdog = HangWatchdog(
+        0.2, exit_fn=exit_fn, poll_s=0.02
+    ).start()
+    watchdog.stop()
+    time.sleep(0.4)
+    assert not exit_fn.called.is_set()
+
+
+def test_context_manager_protocol():
+    exit_fn = FakeExit()
+    with HangWatchdog(5.0, exit_fn=exit_fn) as watchdog:
+        watchdog.beat()
+    assert not exit_fn.called.is_set()
+
+
+def test_rejects_nonpositive_deadline():
+    with pytest.raises(ValueError):
+        HangWatchdog(0.0)
+
+
+def test_dump_all_stacks_lists_live_threads():
+    stream = io.StringIO()
+    barrier = threading.Event()
+    release = threading.Event()
+
+    def parked():
+        barrier.set()
+        release.wait(5.0)
+
+    t = threading.Thread(target=parked, name="parked-thread")
+    t.start()
+    try:
+        assert barrier.wait(5.0)
+        dump_all_stacks(stream)
+    finally:
+        release.set()
+        t.join()
+    output = stream.getvalue()
+    assert "parked-thread" in output
+    assert "MainThread" in output

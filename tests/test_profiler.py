@@ -1,0 +1,85 @@
+"""StepTimer (sav_tpu/utils/profiler.py): percentile summaries, the
+post-pause reset contract, and window trimming — on a patched clock."""
+
+import pytest
+
+import sav_tpu.utils.profiler as profiler
+from sav_tpu.utils.profiler import StepTimer
+
+
+class FakeClock:
+    def __init__(self):
+        self.t = 0.0
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, s):
+        self.t += s
+
+
+@pytest.fixture
+def clock(monkeypatch):
+    c = FakeClock()
+    monkeypatch.setattr(profiler.time, "perf_counter", c)
+    return c
+
+
+def test_empty_timer_summary_is_empty():
+    assert StepTimer().summary() == {}
+
+
+def test_single_tick_records_nothing(clock):
+    timer = StepTimer()
+    timer.tick()
+    assert timer.num_ticks == 0
+    assert timer.summary() == {}
+
+
+def test_percentiles_and_mean(clock):
+    timer = StepTimer()
+    timer.tick()
+    # 100 intervals: 0.01s .. 1.00s.
+    for i in range(1, 101):
+        clock.advance(i / 100.0)
+        timer.tick()
+    s = timer.summary()
+    assert s["step_time_mean_s"] == pytest.approx(0.505)
+    assert s["step_time_p50_s"] == pytest.approx(0.505, abs=0.01)
+    assert s["step_time_p95_s"] == pytest.approx(0.95, abs=0.011)
+
+
+def test_items_per_sec_uses_mean(clock):
+    timer = StepTimer(items_per_step=256)
+    timer.tick()
+    for _ in range(4):
+        clock.advance(0.5)
+        timer.tick()
+    assert timer.summary()["items_per_sec"] == pytest.approx(512.0)
+
+
+def test_reset_swallows_the_pause_gap(clock):
+    timer = StepTimer()
+    timer.tick()
+    clock.advance(0.1)
+    timer.tick()
+    # An eval pause the caller excludes via reset():
+    clock.advance(60.0)
+    timer.reset()
+    timer.tick()
+    clock.advance(0.1)
+    timer.tick()
+    s = timer.summary()
+    assert timer.num_ticks == 2
+    assert s["step_time_mean_s"] == pytest.approx(0.1)
+
+
+def test_window_trims_oldest(clock):
+    timer = StepTimer(window=5)
+    timer.tick()
+    for i in range(10):
+        clock.advance(10.0 if i < 5 else 0.1)
+        timer.tick()
+    # Only the five 0.1s intervals survive the window.
+    assert timer.num_ticks == 5
+    assert timer.summary()["step_time_mean_s"] == pytest.approx(0.1)

@@ -1,0 +1,137 @@
+"""Metric writers (sav_tpu/utils/writers.py): jsonl round-trip,
+MultiWriter composition, and the lazy-degrade no-op sinks."""
+
+import json
+
+import pytest
+
+from sav_tpu.utils.writers import (
+    JsonlWriter,
+    LoggingWriter,
+    MultiWriter,
+    TensorBoardWriter,
+    WandbWriter,
+)
+
+
+def _read_jsonl(path):
+    with open(path) as f:
+        return [json.loads(line) for line in f if line.strip()]
+
+
+def test_jsonl_writer_round_trip(tmp_path):
+    w = JsonlWriter(str(tmp_path))
+    w.write(10, {"loss": 1.5, "top_1_acc": 0.25})
+    w.write(20, {"loss": 1.2})
+    w.close()
+    records = _read_jsonl(w.path)
+    assert records == [
+        {"step": 10, "loss": 1.5, "top_1_acc": 0.25},
+        {"step": 20, "loss": 1.2},
+    ]
+
+
+def test_jsonl_writer_appends_across_instances(tmp_path):
+    w1 = JsonlWriter(str(tmp_path))
+    w1.write(1, {"a": 1.0})
+    w1.close()
+    w2 = JsonlWriter(str(tmp_path))
+    w2.write(2, {"a": 2.0})
+    w2.close()
+    assert [r["step"] for r in _read_jsonl(w2.path)] == [1, 2]
+
+
+def test_jsonl_writer_positional_step_wins_over_metrics_step(tmp_path):
+    w = JsonlWriter(str(tmp_path))
+    w.write(5, {"step": 999, "loss": 1.0})
+    w.close()
+    (rec,) = _read_jsonl(w.path)
+    assert rec["step"] == 5 and isinstance(rec["step"], int)
+
+
+def test_jsonl_writer_passes_through_non_scalar_payloads(tmp_path):
+    w = JsonlWriter(str(tmp_path))
+    w.write(1, {"loss": 0.5, "goodput": {"buckets_s": {"step": 1.0}}})
+    w.close()
+    (rec,) = _read_jsonl(w.path)
+    assert rec["goodput"]["buckets_s"]["step"] == 1.0
+
+
+def test_jsonl_writer_close_idempotent(tmp_path):
+    w = JsonlWriter(str(tmp_path))
+    w.close()
+    w.close()  # must not raise
+
+
+def test_jsonl_writer_custom_filename(tmp_path):
+    w = JsonlWriter(str(tmp_path), filename="eval.jsonl")
+    assert w.path.endswith("eval.jsonl")
+    w.close()
+
+
+def test_logging_writer_formats_floats(tmp_path):
+    lines = []
+    w = LoggingWriter(log_fn=lines.append)
+    w.write(3, {"loss": 0.123456789, "count": 7})
+    w.close()
+    assert lines == ["step 3: loss=0.123457, count=7"]
+
+
+def test_multi_writer_fans_out(tmp_path):
+    lines = []
+    jw = JsonlWriter(str(tmp_path))
+    mw = MultiWriter([jw, LoggingWriter(log_fn=lines.append)])
+    mw.write(1, {"loss": 2.0})
+    mw.close()
+    assert len(_read_jsonl(jw.path)) == 1
+    assert len(lines) == 1
+
+
+def test_multi_writer_closes_all_despite_failures(tmp_path):
+    class Exploding:
+        def write(self, step, metrics):
+            pass
+
+        def close(self):
+            raise RuntimeError("network down")
+
+    jw = JsonlWriter(str(tmp_path))
+    mw = MultiWriter([Exploding(), jw])
+    with pytest.raises(RuntimeError, match="network down"):
+        mw.close()
+    # The failure above must not have skipped the jsonl close.
+    assert jw._f.closed
+
+
+def test_wandb_writer_degrades_without_wandb(monkeypatch):
+    import builtins
+
+    real_import = builtins.__import__
+
+    def block_wandb(name, *args, **kwargs):
+        if name == "wandb":
+            raise ImportError("no wandb in this image")
+        return real_import(name, *args, **kwargs)
+
+    monkeypatch.setattr(builtins, "__import__", block_wandb)
+    w = WandbWriter(project="test")
+    assert not w.active
+    w.write(1, {"loss": 1.0})  # no-op, must not raise
+    w.close()
+
+
+def test_tensorboard_writer_degrades_without_tf(monkeypatch):
+    import builtins
+
+    real_import = builtins.__import__
+
+    def block_tf(name, *args, **kwargs):
+        if name.startswith("sav_tpu.data._tf"):
+            raise ImportError("no tf in this image")
+        return real_import(name, *args, **kwargs)
+
+    monkeypatch.setattr(builtins, "__import__", block_tf)
+    w = TensorBoardWriter(str("unused_dir"))
+    assert not w.active
+    w.write(1, {"loss": 1.0})
+    w.close()

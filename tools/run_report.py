@@ -1,0 +1,211 @@
+#!/usr/bin/env python
+"""Render a run's telemetry into a human-readable summary.
+
+Reads the files the trainer writes to its log dir (train.py --log-dir;
+docs/observability.md):
+
+  metrics.jsonl     — per-window step metrics (+ in-jit diagnostics)
+  goodput.json      — wall-time ledger (compile/step/input-wait/... buckets)
+  spans.trace.json  — host-side span trace (only its event count is shown
+                      here; load the file itself in https://ui.perfetto.dev)
+
+Stdlib-only (no jax import): safe to run on a laptop against rsynced logs.
+
+Usage:
+  python tools/run_report.py runs/vit_ti_patch16
+  python tools/run_report.py --metrics some/metrics.jsonl
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+
+def _fmt_seconds(s: float) -> str:
+    if s >= 3600:
+        return f"{s / 3600:.2f} h"
+    if s >= 60:
+        return f"{s / 60:.2f} min"
+    return f"{s:.2f} s"
+
+
+def _fmt_bytes(b: float) -> str:
+    for unit in ("B", "KiB", "MiB", "GiB", "TiB"):
+        if abs(b) < 1024 or unit == "TiB":
+            return f"{b:.1f} {unit}"
+        b /= 1024
+    return f"{b:.1f} TiB"
+
+
+def load_metrics(path: str) -> list[dict]:
+    records = []
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                records.append(json.loads(line))
+            except json.JSONDecodeError:
+                continue  # torn tail line of a crashed run
+    return records
+
+
+def _series(records: list[dict], key: str) -> list[tuple[int, float]]:
+    out = []
+    for r in records:
+        v = r.get(key)
+        if isinstance(v, (int, float)):
+            out.append((int(r.get("step", 0)), float(v)))
+    return out
+
+
+def _stats_line(name: str, series: list[tuple[int, float]]) -> str:
+    values = [v for _, v in series]
+    lo, hi = min(values), max(values)
+    return (
+        f"  {name:<24} last {values[-1]:<12.6g} "
+        f"min {lo:<12.6g} max {hi:<12.6g} ({len(values)} points)"
+    )
+
+
+def report_metrics(records: list[dict], out) -> None:
+    train = [r for r in records if "loss" in r]
+    evals = [r for r in records if "eval_top_1_acc" in r]
+    print(f"Training windows logged: {len(train)}", file=out)
+    if train:
+        last = train[-1]
+        print(f"Last logged step: {int(last.get('step', 0))}", file=out)
+        for key in ("loss", "top_1_acc", "images_per_sec", "mfu"):
+            s = _series(train, key)
+            if s:
+                print(_stats_line(key, s), file=out)
+        print("Optimization diagnostics (--diagnostics):", file=out)
+        diag_keys = [
+            "grad_norm", "param_norm", "update_norm",
+            "update_to_param_ratio", "nonfinite_grads", "retraces",
+        ]
+        for key in diag_keys:
+            s = _series(train, key)
+            if s:
+                print(_stats_line(key, s), file=out)
+        group_keys = sorted(
+            {k for r in train for k in r if k.startswith("grad_norm/")}
+        )
+        for key in group_keys:
+            s = _series(train, key)
+            if s:
+                print(_stats_line(key, s), file=out)
+        if not _series(train, "param_norm"):
+            print(
+                "  (in-jit diagnostics absent — rerun with --diagnostics)",
+                file=out,
+            )
+        hbm = _series(train, "hbm_peak_bytes")
+        if hbm:
+            print(
+                f"  HBM peak: {_fmt_bytes(hbm[-1][1])} "
+                f"(in use: {_fmt_bytes(_series(train, 'hbm_bytes_in_use')[-1][1])})",
+                file=out,
+            )
+    if evals:
+        best = max(evals, key=lambda r: r["eval_top_1_acc"])
+        print(
+            f"Eval: best top-1 {best['eval_top_1_acc']:.4f} at step "
+            f"{int(best.get('step', 0))} (last "
+            f"{evals[-1]['eval_top_1_acc']:.4f}, {len(evals)} passes)",
+            file=out,
+        )
+
+
+def report_goodput(summary: dict, out) -> None:
+    total = summary.get("wall_s", 0.0)
+    print(
+        f"Goodput ledger: {_fmt_seconds(total)} wall, "
+        f"{summary.get('steps', 0)} steps, "
+        f"goodput {summary.get('goodput_fraction', 0.0):.1%}",
+        file=out,
+    )
+    buckets = summary.get("buckets_s", {})
+    fractions = summary.get("fractions", {})
+    for name, secs in sorted(buckets.items(), key=lambda kv: -kv[1]):
+        if secs <= 0:
+            continue
+        bar = "#" * int(round(40 * fractions.get(name, 0.0)))
+        print(
+            f"  {name:<12} {_fmt_seconds(secs):>12} "
+            f"{fractions.get(name, 0.0):>7.1%}  {bar}",
+            file=out,
+        )
+    anomalies = summary.get("anomalies", [])
+    if anomalies:
+        print(f"  stall anomalies: {len(anomalies)}", file=out)
+        for a in anomalies[:10]:
+            print(
+                f"    step {a.get('step')}: {a.get('per_step_s')}s/step "
+                f"({a.get('slowdown')}x the {a.get('median_per_step_s')}s "
+                "median)",
+                file=out,
+            )
+        if len(anomalies) > 10:
+            print(f"    ... and {len(anomalies) - 10} more", file=out)
+    else:
+        print("  no stall anomalies", file=out)
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        description=__doc__, formatter_class=argparse.RawDescriptionHelpFormatter
+    )
+    parser.add_argument(
+        "log_dir", nargs="?", default=None,
+        help="run log dir containing metrics.jsonl / goodput.json",
+    )
+    parser.add_argument("--metrics", default=None, help="explicit metrics.jsonl")
+    parser.add_argument("--goodput", default=None, help="explicit goodput.json")
+    args = parser.parse_args(argv)
+    if args.log_dir is None and args.metrics is None and args.goodput is None:
+        parser.error("pass a log dir, --metrics, or --goodput")
+
+    metrics_path = args.metrics or (
+        os.path.join(args.log_dir, "metrics.jsonl") if args.log_dir else None
+    )
+    goodput_path = args.goodput or (
+        os.path.join(args.log_dir, "goodput.json") if args.log_dir else None
+    )
+    out = sys.stdout
+    if args.log_dir:
+        print(f"== Run report: {args.log_dir} ==", file=out)
+
+    if metrics_path and os.path.exists(metrics_path):
+        report_metrics(load_metrics(metrics_path), out)
+    elif metrics_path:
+        print(f"(no metrics file at {metrics_path})", file=out)
+
+    if goodput_path and os.path.exists(goodput_path):
+        with open(goodput_path) as f:
+            report_goodput(json.load(f), out)
+    elif goodput_path:
+        print(f"(no goodput ledger at {goodput_path})", file=out)
+
+    if args.log_dir:
+        spans = os.path.join(args.log_dir, "spans.trace.json")
+        if os.path.exists(spans):
+            try:
+                with open(spans) as f:
+                    n = len(json.load(f).get("traceEvents", []))
+                print(
+                    f"Span trace: {spans} ({n} events) — load it in "
+                    "https://ui.perfetto.dev",
+                    file=out,
+                )
+            except json.JSONDecodeError:
+                print(f"Span trace: {spans} (unreadable/torn)", file=out)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

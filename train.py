@@ -15,6 +15,7 @@ Examples:
 from __future__ import annotations
 
 import json
+import os
 import sys
 
 import click
@@ -150,6 +151,34 @@ import click
     "written with the per-leaf optimizer-state layout (pre-round-3).",
 )
 @click.option(
+    "--log-dir", type=str, default=None,
+    help="Telemetry sink: metrics.jsonl, goodput.json and (with "
+    "--trace-spans) spans.trace.json land here. Default: the checkpoint "
+    "dir if given, else runs/<model-name>. Render with tools/run_report.py.",
+)
+@click.option(
+    "--diagnostics/--no-diagnostics", default=False,
+    help="In-jit optimization diagnostics in the step metrics (param/"
+    "update norms, update-to-param ratio, per-layer-group grad norms, "
+    "nonfinite counts) plus HBM + retrace telemetry at log time; rides "
+    "the existing per-log device_get, zero extra transfers "
+    "(docs/observability.md).",
+)
+@click.option(
+    "--trace-spans/--no-trace-spans", default=False,
+    help="Record host-side spans around fit()'s phases (batch fetch, "
+    "shard/H2D, step dispatch, log sync, eval, checkpoint) into a "
+    "Perfetto-loadable <log-dir>/spans.trace.json.",
+)
+@click.option(
+    "--watchdog-secs", type=float, default=None,
+    help="Hang watchdog: when no step completes within this many seconds "
+    "the run dumps all thread stacks + the goodput ledger and aborts with "
+    "exit 4 (backend-probe's exit 3 = never started; 4 = hung mid-run). "
+    "Armed after the first step; size it above the slowest eval/"
+    "checkpoint gap.",
+)
+@click.option(
     "--device-preprocess/--no-device-preprocess", default=False,
     help="Ship post-augment uint8 batches (4x fewer host->device bytes "
     "than f32) and run normalize + CutMix/MixUp inside the jitted step "
@@ -166,9 +195,18 @@ def main(
     checkpoint_dir, init_from,
     eval_only, steps, num_train_images,
     num_eval_images, crop_min_area, train_flip, platform, backend_wait,
-    fused_optimizer,
+    fused_optimizer, log_dir, diagnostics, trace_spans, watchdog_secs,
     device_preprocess, seed,
 ):
+    if platform == "cpu":
+        # Mirror tests/conftest.py: axon plugin *init* dials the relay even
+        # in cpu-pinned processes (PERF.md §12 — registration resets
+        # jax_platforms to prefer itself whenever the trigger var is set),
+        # so the advertised relay-down fallback must drop the trigger var
+        # BEFORE jax import finishes backend setup, not rely on the config
+        # update alone.
+        os.environ.pop("PALLAS_AXON_POOL_IPS", None)
+
     import jax
 
     if platform == "cpu":
@@ -251,6 +289,10 @@ def main(
         pipeline_parallel=pp if pp > 1 else None,
         pipeline_microbatches=pp_microbatches,
         checkpoint_dir=checkpoint_dir,
+        log_dir=log_dir,
+        diagnostics=diagnostics,
+        trace_spans=trace_spans,
+        watchdog_secs=watchdog_secs,
         seed=seed,
         **(
             {"num_train_images": num_train_images}
@@ -275,6 +317,8 @@ def main(
             "clip_grad": "clip_grad_norm", "grad_accum": "grad_accum_steps",
             "checkpoint_dir": "checkpoint_dir", "seed": "seed",
             "device_preprocess": "device_preprocess",
+            "log_dir": "log_dir", "diagnostics": "diagnostics",
+            "trace_spans": "trace_spans", "watchdog_secs": "watchdog_secs",
         }
         overrides = {
             field: getattr(config, field)
@@ -327,6 +371,16 @@ def main(
                 f" over {mesh_axes['data']} data shards) must be "
                 f"divisible by --pp-microbatches {pp_microbatches}"
             )
+    if config.log_dir is None:
+        # Telemetry always has a sink: metrics.jsonl / goodput.json /
+        # spans.trace.json must exist even for flagless smoke runs.
+        import dataclasses
+
+        config = dataclasses.replace(
+            config,
+            log_dir=config.checkpoint_dir
+            or os.path.join("runs", config.model_name),
+        )
     # Refresh locals the data pipeline uses from the final config.
     model_name = config.model_name
     image_size = config.image_size
@@ -443,17 +497,29 @@ def main(
             random_flip=train_flip,
         )
 
+    writer = None
+    if jax.process_index() == 0:
+        from sav_tpu.utils.writers import JsonlWriter
+
+        writer = JsonlWriter(config.log_dir)
+        click.echo(f"telemetry -> {config.log_dir}", err=True)
+
     def log_fn(metrics):
         if jax.process_index() == 0:
             click.echo(json.dumps(metrics))
+            writer.write(int(metrics.get("step", 0)), metrics)
 
-    state, history = trainer.fit(
-        train_iter,
-        num_steps=steps,
-        eval_iter_fn=None if fake_data else eval_iter_fn,
-        state=state,
-        log_fn=log_fn,
-    )
+    try:
+        state, history = trainer.fit(
+            train_iter,
+            num_steps=steps,
+            eval_iter_fn=None if fake_data else eval_iter_fn,
+            state=state,
+            log_fn=log_fn,
+        )
+    finally:
+        if writer is not None:
+            writer.close()
     if jax.process_index() == 0:
         click.echo(f"done at step {int(jax.device_get(state.step))}")
 

@@ -23,6 +23,12 @@ Feeds (``--feed``):
               CutMix/MixUp) over an in-memory source, feeding the real
               train step; also reports the host pipeline's own img/s
   savrec    — the native SavRecord mmap loader feeding the train step
+
+Fed loops run through the async double-buffered device feeder by default
+(sav_tpu/data/feeder.py — host fetch + device_put of batch N+1 overlap
+step N, exactly like Trainer.fit); ``--no-async-feed`` serializes them
+for A/B. ``transfer_bytes_per_batch`` makes the wire format visible:
+``--device-preprocess`` ships uint8 (≈½ the late-bf16 bytes, ¼ of f32).
 """
 
 from __future__ import annotations
@@ -114,11 +120,19 @@ def _feed_iterator(feed, batch_size, image_size, tmpdir, device_preprocess=False
 
 
 def run(model_name, batch_size, steps, backend, image_size, reps, feed,
-        device_preprocess=False):
+        device_preprocess=False, async_feed=True, compilation_cache_dir=None):
     import jax
 
     from sav_tpu.data import synthetic_data_iterator
     from sav_tpu.obs.goodput import GoodputLedger
+
+    if compilation_cache_dir:
+        # Before any compile: repeat benches of the same program then read
+        # XLA binaries from disk instead of re-paying the relay compile
+        # (sav_tpu/utils/compile_cache.py; PERF.md §12's 493 s TNT trace).
+        from sav_tpu.utils.compile_cache import enable_persistent_cache
+
+        enable_persistent_cache(compilation_cache_dir)
 
     # Wall-time ledger over the whole measurement (docs/observability.md):
     # compile vs step vs input-wait decomposition plus per-window stall
@@ -232,7 +246,7 @@ def run(model_name, batch_size, steps, backend, image_size, reps, feed,
         _sum_placed = jax.jit(lambda b: jnp.sum(b.astype(jnp.float32)))
         jax.device_get(_sum_placed(trainer.shard_batch(first)["images"]))
         transfer_s = float("inf")
-        with ledger.measure("input_wait"):
+        with ledger.measure("h2d"):
             for _ in range(3):
                 t0 = time.perf_counter()
                 placed = trainer.shard_batch(first)
@@ -243,18 +257,48 @@ def run(model_name, batch_size, steps, backend, image_size, reps, feed,
         )
         result["transfer_ms_per_batch"] = round(transfer_s * 1e3, 1)
         result["transfer_mb_per_s"] = round(nbytes / transfer_s / 1e6, 1)
+        # Bytes on the wire per batch: uint8 (--device-preprocess) must
+        # come out ≈½ the late-bf16 path's, ¼ of f32 — the lever PERF §7
+        # measured directly in fed throughput.
+        result["transfer_bytes_per_batch"] = nbytes
+        # The measured loop pipelines via the async device feeder (the
+        # production fit() path): a background thread fetches + places
+        # batch N+1 while the device runs step N. --no-async-feed
+        # restores the serial fetch → put → step loop for A/B.
+        feeder = None
+        if async_feed:
+            from sav_tpu.data.feeder import DeviceFeeder
+
+            feeder = DeviceFeeder(
+                it, trainer.shard_batch, depth=2, name="bench-feeder"
+            )
+
+            def next_placed():
+                return next(feeder)
+        else:
+            def next_placed():
+                return trainer.shard_batch(next(it))
         windows = []
-        for rep in range(reps):
-            t0 = time.perf_counter()
-            for _ in range(steps):
-                state, metrics = trainer.train_step(state, next(it), rng)
-            float(jax.device_get(metrics["loss"]))
-            elapsed = time.perf_counter() - t0
-            # Fed windows interleave host fetch + transfer + device step;
-            # the ledger books them as 'step' (end-to-end goodput), with
-            # the host-only and transfer shares reported separately above.
-            ledger.note_window(steps, elapsed, step=(rep + 1) * steps)
-            windows.append(elapsed / steps)
+        try:
+            for rep in range(reps):
+                t0 = time.perf_counter()
+                for _ in range(steps):
+                    state, metrics = trainer.train_step_placed(
+                        state, next_placed(), rng
+                    )
+                float(jax.device_get(metrics["loss"]))
+                elapsed = time.perf_counter() - t0
+                # Fed windows interleave host fetch + transfer + device
+                # step; the ledger books them as 'step' (end-to-end
+                # goodput), with the host-only and transfer shares
+                # reported separately above.
+                ledger.note_window(steps, elapsed, step=(rep + 1) * steps)
+                windows.append(elapsed / steps)
+        finally:
+            if feeder is not None:
+                for k, v in feeder.stats().items():
+                    ledger.set_gauge(f"feeder/{k}", v)
+                feeder.close()
 
     n_chips = len(jax.devices())
     best = min(windows)
@@ -300,6 +344,18 @@ def main(argv=None):
         "(TrainConfig.device_preprocess)",
     )
     parser.add_argument(
+        "--no-async-feed", action="store_true",
+        help="serialize the fed loop (fetch -> device_put -> step on one "
+        "thread) instead of the default async double-buffered feeder "
+        "(sav_tpu/data/feeder.py) -- the A/B arm for overlap wins",
+    )
+    parser.add_argument(
+        "--compilation-cache-dir", default=None,
+        help="persistent XLA compilation cache directory "
+        "(jax_compilation_cache_dir): repeat benches skip the relay "
+        "compile (493s for TNT, PERF.md §12)",
+    )
+    parser.add_argument(
         "--backend-wait", type=float, default=600.0,
         help="seconds to poll for the accelerator relay before giving up "
         "(0 disables; a transient outage then degrades to a late number "
@@ -321,10 +377,14 @@ def main(argv=None):
         args.model, args.batch_size, args.steps, args.backend,
         args.image_size, reps=args.reps, feed=args.feed,
         device_preprocess=args.device_preprocess,
+        async_feed=not args.no_async_feed,
+        compilation_cache_dir=args.compilation_cache_dir,
     )
     feed_desc = args.feed + (
         " uint8+device-preprocess" if args.device_preprocess else ""
     )
+    if args.feed != "synthetic" and args.no_async_feed:
+        feed_desc += " serial"
     # Heavy imports stay function-local so --help never pays for them; the
     # relay probe itself runs in a subprocess (sav_tpu.utils.backend_probe,
     # stdlib-only module behind lazy package re-exports).

@@ -98,6 +98,34 @@ void sav_transpose_nhwc_to_hwcn(const float* in, float* out, int64_t n,
   });
 }
 
+// uint8 [N,H,W,C] → uint8 [N,H,W,C] batch assembly with optional per-image
+// horizontal flip (flip != NULL && flip[i] != 0 reverses W). This is the
+// uint8-on-the-wire path's only host byte transform (device_preprocess
+// ships raw post-augment uint8; normalize/cast run in the jitted step), so
+// it must not bounce through float: threaded memcpy rows, GIL released.
+void sav_u8_passthrough_batch(const uint8_t* in, uint8_t* out, int64_t n,
+                              int64_t h, int64_t w, int64_t c,
+                              const uint8_t* flip, int threads) {
+  const int64_t hwc = h * w * c;
+  const int64_t wc = w * c;
+  parallel_for(n, threads, [&](int64_t i) {
+    const uint8_t* src = in + i * hwc;
+    uint8_t* dst = out + i * hwc;
+    if (flip == nullptr || !flip[i]) {
+      std::memcpy(dst, src, static_cast<size_t>(hwc));
+      return;
+    }
+    for (int64_t y = 0; y < h; ++y) {
+      const uint8_t* srow = src + y * wc;
+      uint8_t* drow = dst + y * wc;
+      for (int64_t x = 0; x < w; ++x) {
+        std::memcpy(drow + x * c, srow + (w - 1 - x) * c,
+                    static_cast<size_t>(c));
+      }
+    }
+  });
+}
+
 int sav_loader_abi_version() { return 1; }
 
 }  // extern "C"

@@ -1,4 +1,5 @@
 from sav_tpu.data.augment_spec import AugmentSpec, parse_augment_spec
+from sav_tpu.data.feeder import DeviceFeeder
 from sav_tpu.data.native_loader import (
     PrefetchLoader,
     native_available,
@@ -14,6 +15,7 @@ from sav_tpu.data.synthetic import fake_data_iterator, synthetic_data_iterator
 __all__ = [
     "AugmentSpec",
     "parse_augment_spec",
+    "DeviceFeeder",
     "PrefetchLoader",
     "native_available",
     "SavRecDataset",

@@ -15,11 +15,11 @@ from __future__ import annotations
 
 import ctypes
 import os
-import queue
-import threading
 from typing import Iterator, Optional
 
 import numpy as np
+
+from sav_tpu.data.feeder import DeviceFeeder
 
 try:
     import ml_dtypes
@@ -62,6 +62,14 @@ def _load():
         c_f32p, c_f32p, ctypes.c_int64, ctypes.c_int64, ctypes.c_int64,
         ctypes.c_int64, ctypes.c_int,
     ]
+    # Added after the v1 release of the ABI; same-version .so files built
+    # before it simply lack the symbol (backward-compatible addition), so
+    # probe instead of bumping the version and orphaning older builds.
+    if hasattr(lib, "sav_u8_passthrough_batch"):
+        lib.sav_u8_passthrough_batch.argtypes = [
+            c_u8p, c_u8p, ctypes.c_int64, ctypes.c_int64, ctypes.c_int64,
+            ctypes.c_int64, c_u8p, ctypes.c_int,
+        ]
     _lib = lib
     return lib
 
@@ -126,6 +134,53 @@ def f32_to_bf16(x: np.ndarray, *, num_threads: Optional[int] = None) -> np.ndarr
     return out.view(_BF16)
 
 
+def passthrough_batch_u8(
+    images: np.ndarray,
+    *,
+    flip: Optional[np.ndarray] = None,
+    num_threads: Optional[int] = None,
+) -> np.ndarray:
+    """uint8 [N,H,W,C] → uint8 [N,H,W,C]: the wire-format passthrough.
+
+    The uint8-on-the-wire output mode (``savrec_train_iterator(
+    normalize=False)`` / ``TrainConfig.device_preprocess``) ships raw
+    post-augment bytes — half the bytes of late-bf16, a quarter of f32 —
+    and its only remaining host transform is assembling a contiguous
+    batch with the per-image horizontal flips applied. This does exactly
+    that in threaded C++ (GIL released), sitting next to
+    :func:`f32_to_bf16` as the uint8 counterpart of the late-cast stage.
+
+    ``flip``: optional bool/uint8 [N] mask; True reverses the W axis of
+    that image. None copies straight through.
+    """
+    assert images.dtype == np.uint8 and images.ndim == 4
+    n, h, w, c = images.shape
+    lib = _load()
+    if flip is not None:
+        flip = np.ascontiguousarray(
+            np.asarray(flip).astype(np.uint8).reshape(n)
+        )
+    if lib is None or not hasattr(lib, "sav_u8_passthrough_batch"):
+        if flip is None:
+            # Always a fresh buffer, matching the native path — callers may
+            # mutate the batch while the source is a reused pool/mmap view.
+            return images.copy(order="C")
+        return np.where(
+            flip.astype(bool)[:, None, None, None], images[:, :, ::-1], images
+        )
+    images = np.ascontiguousarray(images)
+    out = np.empty_like(images)
+    lib.sav_u8_passthrough_batch(
+        images.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+        out.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+        n, h, w, c,
+        None if flip is None
+        else flip.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+        _threads(num_threads),
+    )
+    return out
+
+
 def gather_batch(
     pool: np.ndarray, indices: np.ndarray, *, num_threads: Optional[int] = None
 ) -> np.ndarray:
@@ -172,50 +227,25 @@ def transpose_nhwc_to_hwcn(
     return out
 
 
-class PrefetchLoader:
+class PrefetchLoader(DeviceFeeder):
     """Bounded background prefetch over any batch iterator.
 
     The tf.data path has its own C++ prefetch; this covers every other
     source (synthetic, native-assembled, custom) so host work overlaps
     device steps. Iteration order is preserved (single worker per iterator
     semantics; the byte-heavy transforms above run with the GIL released).
+
+    A thin host-only view of :class:`~sav_tpu.data.feeder.DeviceFeeder`
+    (``transform`` is its ``place_fn``) so the bounded-queue / drain /
+    error-propagation state machine lives in exactly one place; it also
+    inherits ``close()`` and a worker that stays responsive to it instead
+    of wedging on a full queue.
     """
 
     def __init__(self, iterator: Iterator[dict], *, depth: int = 2, transform=None):
-        self._iterator = iterator
-        self._transform = transform
-        self._queue: queue.Queue = queue.Queue(maxsize=depth)
-        self._done = object()
-        self._err: Optional[BaseException] = None
-        self._finished = False
-        self._thread = threading.Thread(target=self._worker, daemon=True)
-        self._thread.start()
-
-    def _worker(self):
-        try:
-            for item in self._iterator:
-                if self._transform is not None:
-                    item = self._transform(item)
-                self._queue.put(item)
-        except BaseException as e:  # propagate to the consumer
-            self._err = e
-        finally:
-            self._queue.put(self._done)
-
-    def __iter__(self):
-        return self
-
-    def __next__(self):
-        # Terminal states persist: the sentinel is consumed exactly once, so
-        # later next() calls must not block on an empty queue.
-        if self._finished:
-            if self._err is not None:
-                raise self._err
-            raise StopIteration
-        item = self._queue.get()
-        if item is self._done:
-            self._finished = True
-            if self._err is not None:
-                raise self._err
-            raise StopIteration
-        return item
+        super().__init__(
+            iterator,
+            transform if transform is not None else lambda item: item,
+            depth=depth,
+            name="prefetch-loader",
+        )

@@ -278,10 +278,13 @@ def savrec_train_iterator(
         ):
             images = batch["images"]
             if flip:
+                # Threaded C++ flip+assemble (GIL released) — on the raw
+                # uint8 wire path (normalize=False, the
+                # device_preprocess pairing) this is the only host byte
+                # transform left, so it must not bounce through
+                # numpy/float.
                 do = flip_rng.random(images.shape[0]) < 0.5
-                images = np.where(
-                    do[:, None, None, None], images[:, :, ::-1], images
-                )
+                images = _nl.passthrough_batch_u8(images, flip=do)
             if normalize:
                 images = _nl.normalize_batch(images, mean, stddev, transpose=transpose)
                 if bfloat16:

@@ -7,13 +7,26 @@ wall clock is split into buckets:
 
   compile     — trace + XLA compile (AOT or the first jit dispatch)
   step        — device training compute (dispatch + log-window sync)
-  input_wait  — host batch fetch + shard/H2D placement
+  input_wait  — host batch fetch: time the training thread blocks waiting
+                for the next batch (with the async feeder this is queue
+                wait only; serial, it is the full host fetch)
+  h2d         — host→device placement (sharded device_put) on the
+                training thread. The async feeder moves this work to a
+                background thread so it overlaps device compute; its
+                overlapped share is then reported as a *gauge*
+                (``feeder/h2d_s``), not a bucket — buckets partition the
+                training thread's wall clock and must still sum to it
   eval        — evaluation passes
   checkpoint  — checkpoint save time on the training thread
   stall       — the *excess* of anomalous step windows over the expected
                 step time (the relay's >5x transient slowdowns,
                 bench.py docstring)
   other       — residual loop overhead (computed, never accounted)
+
+Gauges (:meth:`GoodputLedger.set_gauge`) carry scalar telemetry that is
+not wall time of the training thread — background-thread work, queue
+depths, byte counts. They ride the summary/flat_metrics next to the
+buckets without breaking the buckets-sum-to-wall invariant.
 
 Stall detection is per *logging window* (the granularity at which the
 trainer syncs with the device): a window whose per-step time exceeds
@@ -32,7 +45,8 @@ import time
 from typing import Callable, Optional
 
 BUCKETS = (
-    "compile", "step", "input_wait", "eval", "checkpoint", "stall", "other",
+    "compile", "step", "input_wait", "h2d", "eval", "checkpoint", "stall",
+    "other",
 )
 
 
@@ -50,6 +64,7 @@ class GoodputLedger:
         self.window_history = window_history
         self._buckets: dict[str, float] = {b: 0.0 for b in BUCKETS}
         self._per_step_history: list[float] = []
+        self._gauges: dict[str, float] = {}
         self.anomalies: list[dict] = []
         self.steps = 0
 
@@ -69,6 +84,12 @@ class GoodputLedger:
             yield
         finally:
             self.account(bucket, self._clock() - start)
+
+    def set_gauge(self, name: str, value: float) -> None:
+        """Record a scalar gauge (background-thread seconds, queue depths,
+        byte counts). Gauges are reported next to the buckets but are NOT
+        wall-time buckets — they never enter the sum-to-wall accounting."""
+        self._gauges[name] = float(value)
 
     def _median(self) -> Optional[float]:
         if not self._per_step_history:
@@ -137,6 +158,10 @@ class GoodputLedger:
         }
         if self.anomalies:
             summary["anomalies"] = list(self.anomalies)
+        if self._gauges:
+            summary["gauges"] = {
+                k: round(v, 6) for k, v in self._gauges.items()
+            }
         median = self._median()
         if median is not None:
             summary["median_step_s"] = round(median, 6)
@@ -151,4 +176,6 @@ class GoodputLedger:
             out[prefix + k + "_s"] = v
         out[prefix + "goodput_fraction"] = s["goodput_fraction"]
         out[prefix + "num_anomalies"] = float(s["num_anomalies"])
+        for k, v in s.get("gauges", {}).items():
+            out[prefix + k] = v
         return out

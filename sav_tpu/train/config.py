@@ -51,6 +51,25 @@ class TrainConfig:
     # with it (the iterator rejects the combination).
     device_preprocess: bool = False
 
+    # Async device feed (sav_tpu/data/feeder.py; docs/input_pipeline.md):
+    # fit()/evaluate() pull batches through a background thread that
+    # overlaps host fetch + sharded device_put with device compute
+    # (double buffering). False restores the serial fetch→put→step loop
+    # (the --no-async-feed escape hatch).
+    async_feed: bool = True
+    # Placed batches buffered beyond the one in flight (backpressure
+    # bound). Placed-batch HBM exposure is feed_depth queued + 1 the
+    # worker is placing + feed_depth + 1 dispatched-not-retired (fit and
+    # evaluate both cap run-ahead at that); during an epoch-boundary
+    # eval inside fit() the train feeder's queue stays full, so the two
+    # bounds stack.
+    feed_depth: int = 2
+    # Persistent XLA compilation cache directory
+    # (jax_compilation_cache_dir; sav_tpu/utils/compile_cache.py). Repeat
+    # runs of the same program skip the multi-minute compile — the 493 s
+    # TNT trace (PERF.md §12) becomes a disk read. None disables.
+    compilation_cache_dir: Optional[str] = None
+
     # Data
     global_batch_size: int = 1024
     num_train_images: int = 1_281_167  # ImageNet-1k train

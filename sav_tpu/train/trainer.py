@@ -19,6 +19,7 @@ from __future__ import annotations
 import json
 import os
 import time
+from collections import deque
 from typing import Any, Iterator, Optional
 
 import jax
@@ -58,6 +59,13 @@ class Trainer:
         checkpointer: Optional[Checkpointer] = None,
     ):
         self.config = config
+        if config.compilation_cache_dir:
+            # Before any jit dispatch, so this trainer's own compiles are
+            # covered (a relay reconnection or process restart then reads
+            # the multi-minute compile from disk — PERF.md §12).
+            from sav_tpu.utils.compile_cache import enable_persistent_cache
+
+            enable_persistent_cache(config.compilation_cache_dir)
         self.mesh = mesh if mesh is not None else create_mesh(config.mesh_axes)
         self.compute_dtype = (
             jnp.bfloat16 if config.compute_dtype == "bfloat16" else jnp.float32
@@ -656,6 +664,17 @@ class Trainer:
     def train_step(self, state: TrainState, batch: dict, rng: jax.Array):
         return self._train_step(state, self.shard_batch(batch), rng)
 
+    def train_step_placed(self, state: TrainState, placed: dict, rng: jax.Array):
+        """One jitted update on an already-placed (sharded) batch.
+
+        The step the feeder path consumes: public surface for harnesses
+        that drive placement themselves (bench.py fed modes,
+        tools/feed_micro.py pair it with :meth:`shard_batch` /
+        :class:`~sav_tpu.data.feeder.DeviceFeeder`). :meth:`train_step`
+        is the shard-inline convenience wrapper over the same program.
+        """
+        return self._train_step(state, placed, rng)
+
     def eval_step(self, state: TrainState, batch: dict):
         return self._eval_step(state, self.shard_batch(batch))
 
@@ -683,10 +702,24 @@ class Trainer:
         return out
 
     def evaluate(self, state: TrainState, eval_iter: Iterator[dict]) -> dict:
-        totals: dict[str, float] = {}
+        """Run one evaluation pass over ``eval_iter``.
+
+        The loop is pipelined like fit()'s (config.async_feed): pad+place
+        run on the feeder's background thread so transfer of batch N+1
+        overlaps the device's batch N, and the per-batch sums stay on
+        device until one ``device_get`` at the end — the old per-batch
+        synchronous fetch + sync serialized every stage and inflated eval
+        windows on slow-transfer rigs (PERF.md §7).
+        """
         batch_size: Optional[int] = None
         data_div = int(np.prod([self.mesh.shape[a] for a in batch_axes(self.mesh)]))
-        for batch in eval_iter:
+
+        def place(batch: dict):
+            # Runs on the feeder thread in async mode: pad the (host)
+            # batch to the compiled shape, then shard onto the mesh. The
+            # single feeder worker processes batches in order, so the
+            # first-batch shape fixing is race-free.
+            nonlocal batch_size
             n = len(batch["labels"])
             if batch_size is None:
                 # First batch fixes the compiled shape: its size rounded up
@@ -694,7 +727,41 @@ class Trainer:
                 batch_size = -(-n // data_div) * data_div
             if n < batch_size:
                 batch = self._pad_eval_batch(batch, batch_size)
-            sums = jax.device_get(self.eval_step(state, batch))
+            return self.shard_batch(batch)
+
+        cfg = self.config
+        feeder = None
+        if cfg.async_feed:
+            from sav_tpu.data.feeder import DeviceFeeder
+
+            feeder = DeviceFeeder(
+                iter(eval_iter), place, depth=cfg.feed_depth,
+                name="eval-feeder",
+            )
+            placed_iter = feeder
+        else:
+            placed_iter = map(place, eval_iter)
+        device_sums = []
+        # Dispatches stay async so the device pipelines batches, but
+        # run-ahead must be bounded: every dispatched-not-retired step
+        # holds its input batch in HBM, and a long eval set on a
+        # compute-bound device would otherwise accumulate them all. Once
+        # batch K's sums are ready its inputs are free, so blocking on
+        # the (N - max_inflight)-th sums caps live batches at
+        # feed_depth (queued) + max_inflight (dispatched).
+        max_inflight = cfg.feed_depth + 1
+        retired = 0
+        try:
+            for placed in placed_iter:
+                device_sums.append(self._eval_step(state, placed))
+                if len(device_sums) - retired >= max_inflight:
+                    jax.block_until_ready(device_sums[retired])
+                    retired += 1
+        finally:
+            if feeder is not None:
+                feeder.close()
+        totals: dict[str, float] = {}
+        for sums in jax.device_get(device_sums):
             for k, v in sums.items():
                 totals[k] = totals.get(k, 0.0) + float(v)
         n = max(totals.get("count", 0.0), 1.0)
@@ -725,9 +792,18 @@ class Trainer:
             train.py:239-250 / SURVEY.md §2.9 #21).
           log_fn: callable(dict) for metrics (host-side, outside jit).
 
+        Input feed (docs/input_pipeline.md): with ``config.async_feed``
+        (the default) batches are fetched and placed on device by a
+        background :class:`~sav_tpu.data.feeder.DeviceFeeder` — host fetch
+        and the sharded ``device_put`` of batch N+1 overlap the device's
+        step N, and the loop only blocks on the bounded queue.
+        ``config.async_feed=False`` restores the serial
+        fetch → put → dispatch loop.
+
         Run telemetry (sav_tpu.obs, docs/observability.md): every run keeps
-        a goodput ledger (compile/step/input-wait/eval/checkpoint/stall
-        buckets, written to <log_dir>/goodput.json and exposed as
+        a goodput ledger (compile/step/input-wait/h2d/eval/checkpoint/stall
+        buckets plus ``feeder/*`` gauges, written to <log_dir>/goodput.json
+        and exposed as
         ``self.last_goodput``); ``config.trace_spans`` additionally records
         host-side spans around each phase into a Perfetto-loadable
         <log_dir>/spans.trace.json, and ``config.watchdog_secs`` arms a
@@ -783,6 +859,28 @@ class Trainer:
         # stall buckets at each log boundary (per-window anomaly flags).
         window_s = 0.0
         data_iter = iter(train_iter)
+        feeder = None
+        if cfg.async_feed:
+            # Async double-buffered device feed (sav_tpu/data/feeder.py):
+            # a background thread fetches host batches and issues the
+            # sharded device_put, so transfer of batch N+1 overlaps the
+            # device's step N instead of preceding it. The loop below then
+            # only ever blocks on the bounded queue (booked as
+            # input_wait); the training thread issues no device_put.
+            # NOTE the feeder runs up to feed_depth + 1 batches ahead of
+            # the consumed step; on preemption the prefetched batches are
+            # dropped and re-produced by the resumable iterator (which
+            # replays from the checkpointed step, not iterator position).
+            from sav_tpu.data.feeder import DeviceFeeder
+
+            feeder = DeviceFeeder(
+                data_iter, self.shard_batch, depth=cfg.feed_depth,
+                name="train-feeder",
+            )
+        # Dispatch run-ahead bound (see the step_dispatch block below);
+        # metrics are tiny device scalars, so the deque itself is free.
+        max_inflight = cfg.feed_depth + 1
+        inflight_metrics: deque = deque()
         try:
             for step in range(start_step, num_steps):
                 if cfg.profile_dir is not None:
@@ -797,15 +895,25 @@ class Trainer:
                         jax.block_until_ready(state)
                         profiler.stop_trace()
                         profiling = False
-                with tracer.span("batch_fetch", step=step + 1), \
-                        ledger.measure("input_wait"):
-                    try:
-                        batch = next(data_iter)
-                    except StopIteration:
-                        break
-                with tracer.span("shard_batch", step=step + 1), \
-                        ledger.measure("input_wait"):
-                    sharded = self.shard_batch(batch)
+                if feeder is not None:
+                    # Placed batches arrive ready; the only critical-path
+                    # cost left is the residual queue wait.
+                    with tracer.span("batch_wait", step=step + 1), \
+                            ledger.measure("input_wait"):
+                        try:
+                            sharded = next(feeder)
+                        except StopIteration:
+                            break
+                else:
+                    with tracer.span("batch_fetch", step=step + 1), \
+                            ledger.measure("input_wait"):
+                        try:
+                            batch = next(data_iter)
+                        except StopIteration:
+                            break
+                    with tracer.span("shard_batch", step=step + 1), \
+                            ledger.measure("h2d"):
+                        sharded = self.shard_batch(batch)
                 if peak_flops and compiled_step is None:
                     from sav_tpu.utils.flops import compiled_flops
 
@@ -821,6 +929,18 @@ class Trainer:
                 t_step = time.perf_counter()
                 with tracer.span("step_dispatch", step=step + 1):
                     state, metrics = step_fn(state, sharded, rng)
+                # Cap dispatch run-ahead the same way evaluate() does:
+                # every dispatched-not-retired step holds its placed input
+                # batch in HBM, and with the feeder keeping the host fast
+                # nothing else blocks before the log boundary (up to
+                # log_every_steps batches live). Waiting on the metrics of
+                # the step max_inflight back retires its inputs while the
+                # queue ahead stays full, so placed-batch exposure is
+                # feed_depth (queued) + max_inflight (dispatched). Booked
+                # into the step window: it is device-compute wait.
+                inflight_metrics.append(metrics)
+                if len(inflight_metrics) > max_inflight:
+                    jax.block_until_ready(inflight_metrics.popleft())
                 dispatch_s = time.perf_counter() - t_step
                 if step == start_step and compiled_step is None:
                     # The first jit dispatch blocks through trace+compile;
@@ -927,6 +1047,15 @@ class Trainer:
                 with ledger.measure("checkpoint"):
                     self.checkpointer.wait()
         finally:
+            if feeder is not None:
+                # Publish the worker-side counters as ledger gauges (they
+                # are overlapped background time + queue depths, not
+                # training-thread wall time — see obs/goodput.py), then
+                # stop the worker so a mid-run exception can't leave it
+                # blocked holding placed device buffers.
+                for k, v in feeder.stats().items():
+                    ledger.set_gauge(f"feeder/{k}", v)
+                feeder.close()
             if watchdog is not None:
                 watchdog.stop()
             if profiling:

@@ -1,0 +1,79 @@
+"""Persistent XLA compilation cache.
+
+The TNT two-stream graph takes 493 s to XLA-compile on the relayed chip
+(PERF.md §12), and every relay reconnection — plus every bench/train
+process restart — pays the full recompile again. JAX's persistent
+compilation cache keyed on (HLO, compile options, backend version) turns
+those repeats into a disk read. This module is the single switch point:
+``train.py --compilation-cache-dir`` / ``bench.py --compilation-cache-dir``
+/ ``TrainConfig.compilation_cache_dir`` all land here.
+
+Must be enabled *before* the first compilation of the program to cover it
+(Trainer applies it in ``__init__``, before any jit dispatch). Config
+names are probed defensively so older/newer jax versions degrade to a
+no-op warning instead of crashing the run.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+from typing import Optional
+
+
+def enable_persistent_cache(
+    cache_dir: str,
+    *,
+    min_compile_time_secs: Optional[float] = None,
+) -> bool:
+    """Point XLA's persistent compilation cache at ``cache_dir``.
+
+    Args:
+      cache_dir: directory for cache entries (created if missing). Shared
+        safely between concurrent processes — entries are content-keyed
+        and written atomically by jax.
+      min_compile_time_secs: only persist compilations slower than this
+        (None keeps jax's default, ~1 s — tests pass 0.0 so tiny programs
+        produce entries).
+
+    Returns True if the cache was enabled, False if this jax build does
+    not expose the config (logged, never raised — a missing cache is a
+    slower run, not a broken one).
+    """
+    import jax
+
+    os.makedirs(cache_dir, exist_ok=True)
+    try:
+        jax.config.update("jax_compilation_cache_dir", cache_dir)
+    except (AttributeError, ValueError) as e:  # pragma: no cover - old jax
+        logging.warning("persistent compilation cache unavailable: %s", e)
+        return False
+    if min_compile_time_secs is not None:
+        try:
+            jax.config.update(
+                "jax_persistent_cache_min_compile_time_secs",
+                float(min_compile_time_secs),
+            )
+        except (AttributeError, ValueError):  # pragma: no cover - old jax
+            pass
+    try:
+        # Entry-size floor off: a cached 50 ms CPU step is still a win in
+        # tests, and real TPU programs dwarf any floor anyway.
+        jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
+    except (AttributeError, ValueError):  # pragma: no cover - old jax
+        pass
+    try:
+        # jax freezes the enable/disable decision at the process's FIRST
+        # compilation (compilation_cache._cache_initialized): a trainer
+        # built after any prior jit dispatch — a warmup, another trainer,
+        # an earlier test — would silently get no cache. Reset the
+        # singleton so the new directory takes effect from here on.
+        from jax._src import compilation_cache as _cc
+
+        _cc.reset_cache()
+    except Exception:  # pragma: no cover - private API moved
+        logging.warning(
+            "could not reset jax's compilation-cache singleton; the "
+            "persistent cache only applies if nothing compiled yet"
+        )
+    return True

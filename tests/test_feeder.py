@@ -1,0 +1,382 @@
+"""Async device feeder (sav_tpu/data/feeder.py) — ISSUE 2.
+
+Unit tier: the DeviceFeeder's pipeline semantics with an instrumented
+fake place_fn (overlap ordering, depth bound/backpressure, StopIteration
+drain, exception propagation, shutdown). Integration tier: Trainer.fit()
+is step-identical with the feeder on vs off, the hot loop issues no
+inline device_put (the tier-1 guard), evaluate() matches the serial path,
+the goodput ledger's critical-path input cost (input_wait + h2d) drops
+strictly below the serialized baseline's, and an armed watchdog does not
+false-fire on a feeder-fed run.
+"""
+
+import os
+import threading
+import time
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+from sav_tpu.data.feeder import DeviceFeeder
+
+
+# ------------------------------------------------------------- unit tier
+
+
+def test_order_preserved_and_drain():
+    batches = [{"i": k} for k in range(7)]
+    feeder = DeviceFeeder(iter(batches), lambda b: dict(b, placed=True))
+    out = list(feeder)
+    assert [b["i"] for b in out] == list(range(7))
+    assert all(b["placed"] for b in out)
+    # Terminal state persists — never blocks, never yields again.
+    for _ in range(3):
+        with pytest.raises(StopIteration):
+            next(feeder)
+
+
+def test_overlap_put_of_next_batch_issued_before_step_completes():
+    """The acceptance-criterion ordering proof: with the consumer still
+    'executing' step N (it has NOT called next() again), the feeder must
+    already have issued the place (device_put stand-in) of batch N+1."""
+    placed = [threading.Event() for _ in range(4)]
+
+    def place(batch):
+        placed[batch["i"]].set()
+        return batch
+
+    feeder = DeviceFeeder(
+        iter([{"i": k} for k in range(4)]), place, depth=2
+    )
+    try:
+        b0 = next(feeder)
+        assert b0["i"] == 0
+        # Step 0 is "running" (no further next() call). A serial loop
+        # would not touch batch 1 until the next iteration; the feeder's
+        # worker must place it on its own.
+        assert placed[1].wait(timeout=5.0), (
+            "place of batch N+1 not issued while step N still in flight"
+        )
+        # Double buffering reaches one further ahead too.
+        assert placed[2].wait(timeout=5.0)
+    finally:
+        feeder.close()
+
+
+def test_depth_bounds_backpressure():
+    """A stalled consumer bounds the worker at depth queued + 1 in-flight
+    placements — the feeder can never run away with host/device memory."""
+    placed_count = [0]
+
+    def place(batch):
+        placed_count[0] += 1
+        return batch
+
+    feeder = DeviceFeeder(
+        iter([{"i": k} for k in range(50)]), place, depth=2
+    )
+    try:
+        deadline = time.monotonic() + 5.0
+        # Worker fills the queue (depth=2) and stalls holding one more.
+        while placed_count[0] < 3 and time.monotonic() < deadline:
+            time.sleep(0.01)
+        time.sleep(0.3)  # give a runaway worker time to overshoot
+        assert placed_count[0] == 3  # depth + 1, nothing more
+        next(feeder)  # consuming one frees exactly one slot
+        deadline = time.monotonic() + 5.0
+        while placed_count[0] < 4 and time.monotonic() < deadline:
+            time.sleep(0.01)
+        time.sleep(0.2)
+        assert placed_count[0] == 4
+    finally:
+        feeder.close()
+
+
+def test_exception_in_source_iterator_propagates_after_good_batches():
+    def gen():
+        yield {"i": 0}
+        yield {"i": 1}
+        raise RuntimeError("host pipeline exploded")
+
+    feeder = DeviceFeeder(gen(), lambda b: b, depth=2)
+    assert next(feeder)["i"] == 0
+    assert next(feeder)["i"] == 1
+    with pytest.raises(RuntimeError, match="host pipeline exploded"):
+        next(feeder)
+    # The error is terminal and repeatable, like StopIteration.
+    with pytest.raises(RuntimeError, match="host pipeline exploded"):
+        next(feeder)
+
+
+def test_exception_in_place_fn_propagates():
+    def place(batch):
+        if batch["i"] == 1:
+            raise ValueError("device_put failed")
+        return batch
+
+    feeder = DeviceFeeder(iter([{"i": k} for k in range(3)]), place, depth=2)
+    assert next(feeder)["i"] == 0
+    with pytest.raises(ValueError, match="device_put failed"):
+        next(feeder)
+
+
+def test_close_unblocks_worker_and_poisons_consumer():
+    feeder = DeviceFeeder(
+        iter([{"i": k} for k in range(50)]), lambda b: b, depth=1
+    )
+    # Let the worker wedge itself against the full queue, then close.
+    time.sleep(0.1)
+    feeder.close()
+    feeder._thread.join(timeout=2.0)
+    assert not feeder._thread.is_alive()
+    with pytest.raises(RuntimeError, match="closed"):
+        next(feeder)
+    feeder.close()  # idempotent
+
+
+def test_close_from_another_thread_unblocks_blocked_consumer():
+    """A consumer blocked in next() on an empty queue (slow source) must
+    see the closed state when close() arrives from another thread — the
+    worker drops the sentinel after close, so an untimed get would hang."""
+    gate = threading.Event()
+
+    def gen():
+        gate.wait(10.0)  # slow source: nothing arrives before close()
+        yield {"i": 0}
+
+    feeder = DeviceFeeder(gen(), lambda b: b)
+    result = {}
+
+    def consume():
+        try:
+            next(feeder)
+        except BaseException as e:
+            result["exc"] = e
+
+    t = threading.Thread(target=consume)
+    t.start()
+    time.sleep(0.2)  # let the consumer block on the empty queue
+    feeder.close()
+    t.join(timeout=2.0)
+    gate.set()
+    assert not t.is_alive(), "consumer still blocked after close()"
+    assert isinstance(result.get("exc"), RuntimeError)
+
+
+def test_context_manager_closes():
+    with DeviceFeeder(iter([{"i": 0}]), lambda b: b) as feeder:
+        assert next(feeder)["i"] == 0
+    assert not feeder._thread.is_alive()
+
+
+def test_depth_validation_and_stats():
+    with pytest.raises(ValueError, match="depth"):
+        DeviceFeeder(iter([]), lambda b: b, depth=0)
+    feeder = DeviceFeeder(iter([{"i": 0}]), lambda b: b, depth=3)
+    list(feeder)
+    stats = feeder.stats()
+    assert stats["batches"] == 1.0
+    assert stats["depth"] == 3.0
+    assert set(stats) >= {"fetch_s", "h2d_s", "wait_s", "depth_max", "depth_avg"}
+
+
+# ------------------------------------------------------ integration tier
+
+
+def _feeder_trainer(**config_overrides):
+    from sav_tpu.models import create_model
+    from sav_tpu.train import TrainConfig, Trainer
+
+    base = dict(
+        model_name="vit_ti_patch16",
+        num_classes=10,
+        image_size=32,
+        compute_dtype="float32",
+        global_batch_size=16,
+        num_train_images=16 * 4,
+        num_epochs=2,
+        warmup_epochs=1,
+        lr_scaling_divisor=16,
+        transpose_images=False,
+        log_every_steps=2,
+        seed=0,
+    )
+    base.update(config_overrides)
+    config = TrainConfig(**base)
+    model = create_model(
+        config.model_name, num_classes=config.num_classes,
+        dtype=jnp.float32, num_layers=2, embed_dim=64, num_heads=4,
+    )
+    return Trainer(config, model=model)
+
+
+def _batches(n, seed=0, batch_size=16):
+    rng = np.random.default_rng(seed)
+    return [
+        {
+            "images": rng.standard_normal(
+                (batch_size, 32, 32, 3)
+            ).astype(np.float32),
+            "labels": rng.integers(0, 10, (batch_size,), np.int32),
+        }
+        for _ in range(n)
+    ]
+
+
+def test_fit_step_identical_with_feeder_on_vs_off(devices):
+    """The feeder changes *when* batches reach the device, never *what*
+    the step computes: same data, same seeds → bit-comparable history and
+    final parameters either way."""
+    batches = _batches(4)
+    results = {}
+    for async_feed in (True, False):
+        trainer = _feeder_trainer(async_feed=async_feed)
+        state, history = trainer.fit(iter(list(batches)), num_steps=4)
+        train = [h for h in history if "loss" in h]
+        results[async_feed] = (
+            jax.device_get(jax.tree.leaves(state.params)[0]),
+            [h["loss"] for h in train],
+            int(jax.device_get(state.step)),
+        )
+    np.testing.assert_array_equal(results[True][0], results[False][0])
+    np.testing.assert_array_equal(results[True][1], results[False][1])
+    assert results[True][2] == results[False][2] == 4
+
+
+def test_fit_hot_loop_issues_no_inline_device_put(devices):
+    """Tier-1 guard (ISSUE 2): with async_feed on (the default), the
+    training thread must never call shard_batch — every sharded
+    device_put belongs to the feeder's background thread. A regression
+    that re-inlines placement into the fit() loop fails here."""
+    trainer = _feeder_trainer()
+    assert trainer.config.async_feed, "async feed must be the default"
+    calling_threads = []
+    orig = trainer.shard_batch
+
+    def recording_shard_batch(batch):
+        calling_threads.append(threading.current_thread())
+        return orig(batch)
+
+    trainer.shard_batch = recording_shard_batch
+    state, _ = trainer.fit(iter(_batches(3)), num_steps=3)
+    assert int(jax.device_get(state.step)) == 3
+    assert calling_threads, "shard_batch never called"
+    main = threading.main_thread()
+    inline = [t for t in calling_threads if t is main]
+    assert not inline, (
+        f"{len(inline)} blocking shard_batch/device_put calls on the "
+        "training thread — the fit() hot loop reserialized the feed"
+    )
+    assert all(t.name == "train-feeder" for t in calling_threads)
+
+
+def test_fit_feeder_goodput_below_serialized_baseline(devices):
+    """Acceptance criterion: over the same (deliberately slow) host
+    stream, the feeder run's critical-path input cost — input_wait + h2d
+    — is strictly below the serialized baseline's, and the ledger carries
+    the feeder gauges + batch_wait spans that show why."""
+    import json
+
+    def slow_iter(n, delay_s=0.03):
+        for b in _batches(n, seed=1):
+            time.sleep(delay_s)
+            yield b
+
+    input_cost = {}
+    for async_feed in (True, False):
+        trainer = _feeder_trainer(async_feed=async_feed)
+        trainer.fit(slow_iter(8), num_steps=8)
+        g = trainer.last_goodput
+        input_cost[async_feed] = (
+            g["buckets_s"]["input_wait"] + g["buckets_s"]["h2d"]
+        )
+        if async_feed:
+            gauges = g["gauges"]
+            assert gauges["feeder/batches"] == 8.0
+            assert gauges["feeder/h2d_s"] > 0.0
+            assert gauges["feeder/depth_max"] >= 1.0
+        else:
+            # Serial loop books placement in h2d, fetch in input_wait.
+            assert g["buckets_s"]["h2d"] > 0.0
+            assert g["buckets_s"]["input_wait"] >= 8 * 0.03
+    assert input_cost[True] < input_cost[False], input_cost
+
+
+def test_fit_feeder_with_watchdog_and_spans(tmp_path, devices):
+    """Watchdog interplay: a healthy feeder-fed run beats the watchdog
+    (fit would os._exit(4) on a false fire), and the span trace shows the
+    feeder-mode phase (batch_wait) instead of the serial fetch/shard."""
+    import json
+
+    trainer = _feeder_trainer(
+        watchdog_secs=300.0, trace_spans=True, log_dir=str(tmp_path)
+    )
+    state, history = trainer.fit(iter(_batches(4)), num_steps=4)
+    assert int(jax.device_get(state.step)) == 4
+    with open(os.path.join(str(tmp_path), "spans.trace.json")) as f:
+        names = {
+            e["name"] for e in json.load(f)["traceEvents"]
+            if e.get("ph") == "X"
+        }
+    assert "batch_wait" in names
+    assert "shard_batch" not in names
+    # Ledger invariant survives the feeder: buckets still partition the
+    # training thread's wall clock (background h2d is gauges, not time).
+    g = trainer.last_goodput
+    assert sum(g["buckets_s"].values()) == pytest.approx(
+        g["wall_s"], rel=0.05
+    )
+
+
+def test_evaluate_feeder_matches_serial_with_padded_final_batch(devices):
+    """evaluate() through the feeder = the serial path, including the
+    pad+mask of a non-divisible final batch (50 examples, batches of 16,
+    8-way mesh)."""
+
+    def eval_iter():
+        rng = np.random.default_rng(3)
+        remaining = 50
+        while remaining > 0:
+            n = min(16, remaining)
+            yield {
+                "images": rng.standard_normal((n, 32, 32, 3)).astype(
+                    np.float32
+                ),
+                "labels": rng.integers(0, 10, (n,), dtype=np.int32),
+            }
+            remaining -= n
+
+    results = {}
+    for async_feed in (True, False):
+        trainer = _feeder_trainer(async_feed=async_feed)
+        state = trainer.init_state()
+        results[async_feed] = trainer.evaluate(state, eval_iter())
+    assert results[True]["eval_count"] == 50.0
+    for key in ("eval_loss", "eval_top_1_acc", "eval_top_5_acc"):
+        np.testing.assert_allclose(
+            results[True][key], results[False][key], rtol=1e-6
+        )
+
+
+def test_compilation_cache_dir_persists_compiles(tmp_path, devices):
+    """TrainConfig.compilation_cache_dir routes compiles through the
+    persistent XLA cache: after one step, the directory holds entries
+    (what makes the 493 s TNT recompile a disk read on round trips)."""
+    from sav_tpu.utils.compile_cache import enable_persistent_cache
+
+    cache_dir = str(tmp_path / "xla_cache")
+    try:
+        # Floor at 0 so the tiny CPU test program qualifies for the cache
+        # (the Trainer default keeps jax's ~1 s floor for real programs).
+        assert enable_persistent_cache(cache_dir, min_compile_time_secs=0.0)
+        trainer = _feeder_trainer(compilation_cache_dir=cache_dir)
+        state = trainer.init_state()
+        batch = _batches(1)[0]
+        state, _ = trainer.train_step(state, batch, jax.random.PRNGKey(0))
+        jax.block_until_ready(state)
+        assert os.listdir(cache_dir), "no persistent cache entries written"
+    finally:
+        jax.config.update("jax_compilation_cache_dir", None)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)

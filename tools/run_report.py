@@ -140,6 +140,32 @@ def report_goodput(summary: dict, out) -> None:
             f"{fractions.get(name, 0.0):>7.1%}  {bar}",
             file=out,
         )
+    gauges = summary.get("gauges", {})
+    feeder = {
+        k[len("feeder/"):]: v
+        for k, v in gauges.items() if k.startswith("feeder/")
+    }
+    if feeder:
+        # Background-thread work the async feeder overlapped with device
+        # compute — not wall-time buckets (the buckets above already sum
+        # to wall). h2d_s hidden behind 'step' is the overlap win;
+        # depth_avg ~ depth means the buffer stayed full (input-bound
+        # runs sit near 0 instead).
+        print(
+            f"  async feeder: {int(feeder.get('batches', 0))} batches, "
+            f"h2d {_fmt_seconds(feeder.get('h2d_s', 0.0))} + fetch "
+            f"{_fmt_seconds(feeder.get('fetch_s', 0.0))} overlapped "
+            f"(consumer waited {_fmt_seconds(feeder.get('wait_s', 0.0))}; "
+            f"depth avg {feeder.get('depth_avg', 0.0):.2f}/"
+            f"{int(feeder.get('depth', 0))}, "
+            f"max {int(feeder.get('depth_max', 0))})",
+            file=out,
+        )
+    other_gauges = {
+        k: v for k, v in gauges.items() if not k.startswith("feeder/")
+    }
+    for name, value in sorted(other_gauges.items()):
+        print(f"  gauge {name}: {value:g}", file=out)
     anomalies = summary.get("anomalies", [])
     if anomalies:
         print(f"  stall anomalies: {len(anomalies)}", file=out)

@@ -184,6 +184,27 @@ import click
     "than f32) and run normalize + CutMix/MixUp inside the jitted step "
     "with replayable jax.random draws (sav_tpu/ops/preprocess.py).",
 )
+@click.option(
+    "--async-feed/--no-async-feed", default=True,
+    help="Async double-buffered device feed (docs/input_pipeline.md): a "
+    "background thread fetches host batches and issues the sharded "
+    "device_put so transfer of batch N+1 overlaps device step N. "
+    "--no-async-feed restores the serial fetch->put->step loop.",
+)
+@click.option(
+    "--feed-depth", type=int, default=2,
+    help="Placed batches the async feeder buffers beyond the one in "
+    "flight (backpressure bound; placed-batch HBM exposure is 2*depth+2 "
+    "-- depth queued + 1 being placed + depth+1 dispatched, see "
+    "docs/input_pipeline.md).",
+)
+@click.option(
+    "--compilation-cache-dir", type=str, default=None,
+    help="Persistent XLA compilation cache directory "
+    "(jax_compilation_cache_dir): restarts and relay reconnections load "
+    "compiled programs from disk instead of re-paying multi-minute "
+    "compiles (PERF.md §12: 493s for TNT).",
+)
 @click.option("--seed", type=int, default=42)
 @click.pass_context
 def main(
@@ -196,7 +217,7 @@ def main(
     eval_only, steps, num_train_images,
     num_eval_images, crop_min_area, train_flip, platform, backend_wait,
     fused_optimizer, log_dir, diagnostics, trace_spans, watchdog_secs,
-    device_preprocess, seed,
+    device_preprocess, async_feed, feed_depth, compilation_cache_dir, seed,
 ):
     if platform == "cpu":
         # Mirror tests/conftest.py: axon plugin *init* dials the relay even
@@ -284,6 +305,9 @@ def main(
         grad_accum_steps=grad_accum,
         fused_optimizer=fused_optimizer,
         device_preprocess=device_preprocess,
+        async_feed=async_feed,
+        feed_depth=feed_depth,
+        compilation_cache_dir=compilation_cache_dir,
         mesh_axes=mesh_axes,
         sequence_parallel=sp_method if sp > 1 else None,
         pipeline_parallel=pp if pp > 1 else None,
@@ -317,6 +341,8 @@ def main(
             "clip_grad": "clip_grad_norm", "grad_accum": "grad_accum_steps",
             "checkpoint_dir": "checkpoint_dir", "seed": "seed",
             "device_preprocess": "device_preprocess",
+            "async_feed": "async_feed", "feed_depth": "feed_depth",
+            "compilation_cache_dir": "compilation_cache_dir",
             "log_dir": "log_dir", "diagnostics": "diagnostics",
             "trace_spans": "trace_spans", "watchdog_secs": "watchdog_secs",
         }

@@ -1,0 +1,878 @@
+"""savlint rules: the TPU/JAX failure modes worth failing CI over.
+
+Every rule carries an ID (stable — pragmas and the baseline key on it),
+a severity, a one-line fix-it hint, and a docstring that is the
+catalogue entry rendered into docs/static_analysis.md. The common theme:
+each rule encodes a discipline the runtime already depends on (PR 1's
+retrace counter, PR 2's feeder threading contract) but that nothing
+enforced statically — so a future edit could silently regress a
+multi-hour TPU run. Rules are heuristics, not proofs: the pragma and
+baseline escapes exist precisely because ``evaluate()``'s one
+end-of-pass ``device_get`` is correct and ``bench.py``'s sync-per-step
+is the point. The bar for a rule is "a finding is worth a human reading
+the line", not zero false positives.
+
+Adding a rule (docs/static_analysis.md has the full recipe): subclass
+:class:`Rule`, pick the next SAV1xx id, implement ``check(module)``
+yielding :class:`~sav_tpu.analysis.lint.Finding`, append to
+``ALL_RULES``, add a known-bad + known-clean fixture pair under
+tests/analysis_fixtures/ and an entry in tests/test_savlint_rules.py.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator
+
+from sav_tpu.analysis.lint import Finding, ModuleInfo, _bare_name
+
+# Functions forming the training hot path: syncs here serialize the
+# device pipeline every step (or every eval batch). The names are the
+# trainer's public + jitted-impl surface; a repo-specific harness can
+# mark extra ones hot with a matching name.
+HOT_FUNCTIONS = frozenset(
+    {
+        "fit",
+        "evaluate",
+        "train_step",
+        "eval_step",
+        "train_step_placed",
+        "train_many_steps",
+        "_train_step_impl",
+        "_train_many_impl",
+        "_eval_step_impl",
+    }
+)
+
+# jax.random derivation fns — NOT consumers; everything else under
+# jax.random that takes a key as its first argument consumes it.
+_KEY_DERIVERS = frozenset(
+    {"split", "fold_in", "PRNGKey", "key", "key_data", "wrap_key_data", "clone"}
+)
+
+_TIME_CALLS = frozenset(
+    {
+        "time.time",
+        "time.time_ns",
+        "time.perf_counter",
+        "time.perf_counter_ns",
+        "time.monotonic",
+        "time.process_time",
+        "datetime.datetime.now",
+        "datetime.datetime.utcnow",
+    }
+)
+
+# Paths whose code runs under bf16 compute by default (the model zoo and
+# the device-side ops): an f32-defaulting constructor here silently
+# promotes every downstream op (docs/static_analysis.md, SAV108).
+BF16_PATHS = ("sav_tpu/models/", "sav_tpu/ops/")
+
+
+def _finding(rule, node, message, hint="", code=""):
+    return Finding(
+        rule=rule.id,
+        severity=rule.severity,
+        path="",
+        line=getattr(node, "lineno", 1),
+        col=getattr(node, "col_offset", 0),
+        message=message,
+        hint=hint or rule.hint,
+        code=code,
+        end_line=getattr(node, "end_lineno", 0) or getattr(node, "lineno", 1),
+    )
+
+
+def _walk_excluding_nested(fn) -> Iterator[ast.AST]:
+    """Nodes of ``fn``'s body, not descending into nested function/lambda.
+
+    For thread- and hot-loop-scoped rules: a closure handed to a feeder
+    runs on another thread (or inside a trace) and must be judged in its
+    own scope, not its parent's.
+    """
+    stack = list(fn.body)
+    while stack:
+        node = stack.pop()
+        yield node
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)):
+            continue
+        stack.extend(ast.iter_child_nodes(node))
+
+
+class Rule:
+    id: str = ""
+    name: str = ""
+    severity: str = "error"
+    hint: str = ""
+
+    def check(self, module: ModuleInfo) -> Iterator[Finding]:
+        raise NotImplementedError
+
+
+# ---------------------------------------------------------------- SAV101
+
+
+class HostSyncInHotLoop(Rule):
+    """Host synchronization reachable from the training hot path.
+
+    ``jax.device_get`` / ``block_until_ready`` / ``.item()`` /
+    ``np.asarray`` inside ``fit()``, ``evaluate()``, or a jitted step
+    implementation forces the dispatch pipeline to drain: the host
+    blocks until the device catches up, the device then idles until the
+    host dispatches again — the serialization PR 2's feeder exists to
+    remove. ``float(x[...])``/``int(x.attr)`` are the same sync in
+    disguise (implicit ``__float__`` on a device scalar). Legitimate
+    sites exist — the per-log-window metrics sync, eval's single
+    end-of-pass ``device_get``, the run-ahead cap — and each must be
+    allowlisted with a pragma stating why, so the next reader knows the
+    sync is priced in rather than accidental.
+    """
+
+    id = "SAV101"
+    name = "host-sync-in-hot-loop"
+    severity = "error"
+    hint = (
+        "keep values on device (stack/sum device-side, one device_get at a "
+        "boundary); if this sync is intentional, pragma it with a "
+        "justification"
+    )
+
+    SYNC_CALLS = {
+        "jax.device_get": "jax.device_get blocks on the device",
+        "jax.block_until_ready": "jax.block_until_ready drains the pipeline",
+        "numpy.asarray": "np.asarray on a device array is a blocking D2H copy",
+        "numpy.array": "np.array on a device array is a blocking D2H copy",
+    }
+    SYNC_METHODS = {
+        "item": ".item() pulls a device scalar to host",
+        "block_until_ready": ".block_until_ready() drains the pipeline",
+    }
+
+    def check(self, module):
+        seen: set[int] = set()
+        for fn in module.functions:
+            if fn.name not in HOT_FUNCTIONS:
+                continue
+            for node in ast.walk(fn):
+                if id(node) in seen or not isinstance(node, ast.Call):
+                    continue
+                seen.add(id(node))
+                resolved = module.resolve_call(node)
+                where = f"in hot function {fn.name}()"
+                if resolved in self.SYNC_CALLS:
+                    yield _finding(
+                        self, node, f"{self.SYNC_CALLS[resolved]} {where}"
+                    )
+                    continue
+                if (
+                    isinstance(node.func, ast.Attribute)
+                    and node.func.attr in self.SYNC_METHODS
+                    and not node.args
+                    and not node.keywords
+                ):
+                    yield _finding(
+                        self,
+                        node,
+                        f"{self.SYNC_METHODS[node.func.attr]} {where}",
+                    )
+                    continue
+                if (
+                    isinstance(node.func, ast.Name)
+                    and node.func.id in ("float", "int")
+                    and len(node.args) == 1
+                    and isinstance(node.args[0], (ast.Subscript, ast.Attribute))
+                ):
+                    yield _finding(
+                        self,
+                        node,
+                        f"{node.func.id}() on a subscript/attribute {where} "
+                        "implicitly syncs a device scalar to host",
+                    )
+
+
+# ---------------------------------------------------------------- SAV102
+
+
+class JitWithoutDonation(Rule):
+    """State-carrying step function jitted without buffer donation.
+
+    A train step that takes the parameter/optimizer state and returns
+    the next state must donate it (``donate_argnums``): without donation
+    XLA keeps both generations of every buffer live across the update —
+    on a memory-bound model that is the difference between fitting and
+    OOM, and it costs an extra copy either way. Functions with ``eval``
+    or ``init`` in their name are exempt: eval reuses the state across
+    batches (donating it would be a use-after-donate crash) and init has
+    nothing to donate.
+    """
+
+    id = "SAV102"
+    name = "jit-without-donation"
+    severity = "warning"
+    hint = (
+        "jax.jit(step, donate_argnums=(0,)) so the old state's buffers are "
+        "reused in place"
+    )
+
+    STATE_PARAMS = frozenset({"state", "train_state", "opt_state"})
+
+    def _first_param(self, fn):
+        args = list(fn.args.posonlyargs) + list(fn.args.args)
+        names = [a.arg for a in args]
+        if names and names[0] == "self":
+            names = names[1:]
+        return names[0] if names else None
+
+    def _exempt(self, name: str) -> bool:
+        return "eval" in name or "init" in name
+
+    def check(self, module):
+        by_name = {}
+        for fn in module.functions:
+            by_name.setdefault(fn.name, fn)
+        for node in ast.walk(module.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            if module.resolve_call(node) != "jax.jit" or not node.args:
+                continue
+            kwargs = {k.arg for k in node.keywords}
+            if kwargs & {"donate_argnums", "donate_argnames"}:
+                continue
+            target = _bare_name(node.args[0])
+            fn = by_name.get(target) if target else None
+            if fn is None or self._exempt(fn.name):
+                continue
+            if self._first_param(fn) in self.STATE_PARAMS:
+                yield _finding(
+                    self,
+                    node,
+                    f"jax.jit({target}) carries state (first parameter "
+                    f"{self._first_param(fn)!r}) but donates nothing — both "
+                    "state generations stay live across every step",
+                )
+        # Decorator forms: bare @jax.jit cannot pass donate_argnums at
+        # all; @partial(jax.jit, ...) can but may have forgotten to.
+        for fn in module.jitted_defs:
+            if self._exempt(fn.name) or (
+                self._first_param(fn) not in self.STATE_PARAMS
+            ):
+                continue
+            for dec in fn.decorator_list:
+                if module.resolve(dec) == "jax.jit":
+                    yield _finding(
+                        self,
+                        dec,
+                        f"@jax.jit on {fn.name}() carries state but a bare "
+                        "decorator cannot donate",
+                        hint="use @partial(jax.jit, donate_argnums=(0,))",
+                    )
+                elif (
+                    isinstance(dec, ast.Call)
+                    and module.resolve_call(dec)
+                    in ("functools.partial", "partial")
+                    and dec.args
+                    and module.resolve(dec.args[0]) == "jax.jit"
+                    and not (
+                        {k.arg for k in dec.keywords}
+                        & {"donate_argnums", "donate_argnames"}
+                    )
+                ):
+                    yield _finding(
+                        self,
+                        dec,
+                        f"@partial(jax.jit) on {fn.name}() carries state "
+                        "but donates nothing — both state generations stay "
+                        "live across every step",
+                    )
+
+
+# ---------------------------------------------------------------- SAV103
+
+
+class PrngKeyReuse(Rule):
+    """The same PRNG key consumed by more than one random op.
+
+    Two samplers fed the same key draw *correlated* values — dropout
+    masks equal to stochastic-depth draws, augmentation mixes mirroring
+    initialization noise. The failure is silent: shapes check out,
+    training even converges, just worse. Keys must be split
+    (``jax.random.split``) or derived (``fold_in``) per consumer;
+    deriving does not count as consumption. The check is per-scope and
+    flow-insensitive (an if/else consuming the same key once per branch
+    is a false positive worth a pragma).
+    """
+
+    id = "SAV103"
+    name = "prng-key-reuse"
+    severity = "error"
+    hint = (
+        "split the key per consumer (k1, k2 = jax.random.split(key)) or "
+        "derive with jax.random.fold_in(key, tag)"
+    )
+
+    def check(self, module):
+        for fn in module.functions:
+            events = []  # (line, col, kind, name, node)
+            for node in _walk_excluding_nested(fn):
+                if isinstance(node, ast.Assign):
+                    for t in node.targets:
+                        for leaf in ast.walk(t):
+                            if isinstance(leaf, ast.Name):
+                                events.append(
+                                    (leaf.lineno, leaf.col_offset, "assign",
+                                     leaf.id, None)
+                                )
+                elif isinstance(node, ast.Call):
+                    resolved = module.resolve_call(node)
+                    if not resolved or not resolved.startswith("jax.random."):
+                        continue
+                    leaf_fn = resolved.rsplit(".", 1)[1]
+                    if leaf_fn in _KEY_DERIVERS:
+                        continue
+                    if node.args and isinstance(node.args[0], ast.Name):
+                        events.append(
+                            (node.lineno, node.col_offset, "consume",
+                             node.args[0].id, node)
+                        )
+            events.sort(key=lambda e: (e[0], e[1]))
+            consumed: dict[str, int] = {}
+            for line, _col, kind, name, node in events:
+                if kind == "assign":
+                    consumed.pop(name, None)
+                else:
+                    first = consumed.get(name)
+                    if first is None:
+                        consumed[name] = line
+                    else:
+                        yield _finding(
+                            self,
+                            node,
+                            f"key {name!r} already consumed at line {first} "
+                            f"in {fn.name}() and is consumed again here — "
+                            "the two draws are correlated",
+                        )
+
+
+# ---------------------------------------------------------------- SAV104
+
+
+class PythonScalarArgRetrace(Rule):
+    """A loop-varying Python scalar passed straight into a jitted call.
+
+    ``step(state, i)`` inside ``for i in range(n)`` hurts either way the
+    scalar is treated: marked static, jit compiles one program per
+    distinct value — ``n`` retraces, each minutes on the relay; left
+    dynamic, the scalar is implicitly uploaded host→device on every
+    single call (the transfer sanitizer flags exactly this at runtime).
+    Loop counters belong on device (fold them into the carried state,
+    like ``state.step``) or in the data, never in the jitted call's
+    Python arguments.
+    """
+
+    id = "SAV104"
+    name = "python-scalar-arg-retrace"
+    severity = "error"
+    hint = (
+        "carry the counter in device state (state.step), pass it as a "
+        "jnp array, or mark the parameter static on purpose"
+    )
+
+    def _int_loop_vars(self, loop: ast.For):
+        """Loop targets that are Python ints: range() binds every target,
+        enumerate() binds the first element of a tuple target."""
+        if not isinstance(loop.iter, ast.Call):
+            return set()
+        if not isinstance(loop.iter.func, ast.Name):
+            return set()
+        fn = loop.iter.func.id
+        if fn == "range":
+            return {
+                n.id for n in ast.walk(loop.target) if isinstance(n, ast.Name)
+            }
+        if fn == "enumerate" and isinstance(loop.target, ast.Tuple):
+            first = loop.target.elts[0]
+            if isinstance(first, ast.Name):
+                return {first.id}
+        return set()
+
+    def check(self, module):
+        if not module.jitted_names:
+            return
+        for loop in ast.walk(module.tree):
+            if not isinstance(loop, ast.For):
+                continue
+            loop_vars = self._int_loop_vars(loop)
+            if not loop_vars:
+                continue
+            for node in ast.walk(loop):
+                if not isinstance(node, ast.Call):
+                    continue
+                callee = _bare_name(node.func)
+                if callee not in module.jitted_names:
+                    continue
+                for arg in list(node.args) + [k.value for k in node.keywords]:
+                    bad = (
+                        isinstance(arg, ast.Name) and arg.id in loop_vars
+                    ) or (
+                        isinstance(arg, ast.BinOp)
+                        and any(
+                            isinstance(n, ast.Name) and n.id in loop_vars
+                            for n in ast.walk(arg)
+                        )
+                    )
+                    if bad:
+                        yield _finding(
+                            self,
+                            node,
+                            f"jitted {callee}() receives the Python loop "
+                            "counter as an argument — a retrace per value "
+                            "if static, an implicit host→device upload "
+                            "every call if not",
+                        )
+                        break
+
+
+# ---------------------------------------------------------------- SAV105
+
+
+class TimeInJit(Rule):
+    """Wall-clock calls inside jit-traced code.
+
+    ``time.time()`` in a jitted function runs **once, at trace time**:
+    the value is baked into the compiled program as a constant, so the
+    "timestamp" never advances and any timing math built on it is
+    silently wrong (and differs between a cached and a fresh compile).
+    Timing belongs on the host, around the dispatch — the span tracer
+    and goodput ledger (PR 1) exist for exactly this.
+    """
+
+    id = "SAV105"
+    name = "time-in-jit"
+    severity = "error"
+    hint = (
+        "time on the host around the jitted call (obs.spans / "
+        "obs.goodput), never inside the trace"
+    )
+
+    def check(self, module):
+        seen: set[int] = set()
+        for fn in module.jitted_defs:
+            for node in ast.walk(fn):
+                if id(node) in seen or not isinstance(node, ast.Call):
+                    continue
+                seen.add(id(node))
+                resolved = module.resolve_call(node)
+                if resolved in _TIME_CALLS:
+                    yield _finding(
+                        self,
+                        node,
+                        f"{resolved}() inside jitted {fn.name}() is evaluated "
+                        "once at trace time and frozen into the program",
+                    )
+
+
+# ---------------------------------------------------------------- SAV106
+
+
+class InlineDevicePutInFit(Rule):
+    """Blocking device placement on the training thread's hot loop.
+
+    With the async feeder on (the default since PR 2), every sharded
+    ``device_put`` belongs to the feeder's background thread; a
+    ``device_put``/``shard_batch`` call in ``fit()`` or ``evaluate()``
+    re-serializes host→device transfer into the critical path and
+    quietly undoes the overlap the feeder bought. This rule is the
+    static home of the invariant tests/test_feeder.py used to assert by
+    instrumenting threads; the serial fallback path
+    (``async_feed=False``) is the one sanctioned exception and carries
+    the pragma. Closures are exempt — a ``place`` closure handed to the
+    feeder *runs on the feeder thread*.
+    """
+
+    id = "SAV106"
+    name = "inline-device-put-in-fit"
+    severity = "error"
+    hint = (
+        "route placement through the DeviceFeeder (async_feed) so the "
+        "transfer overlaps device compute; see docs/input_pipeline.md"
+    )
+
+    PLACE_CALLS = {"jax.device_put", "jax.make_array_from_process_local_data"}
+    PLACE_METHODS = {"shard_batch"}
+
+    def check(self, module):
+        for fn in module.functions:
+            if fn.name not in ("fit", "evaluate"):
+                continue
+            for node in _walk_excluding_nested(fn):
+                if not isinstance(node, ast.Call):
+                    continue
+                resolved = module.resolve_call(node)
+                callee = _bare_name(node.func)
+                if resolved in self.PLACE_CALLS or callee in self.PLACE_METHODS:
+                    yield _finding(
+                        self,
+                        node,
+                        f"inline device placement ({callee}) on the training "
+                        f"thread in {fn.name}() — transfer serializes into "
+                        "the hot loop instead of overlapping via the feeder",
+                    )
+
+
+# ---------------------------------------------------------------- SAV107
+
+
+class UnlockedThreadSharedState(Rule):
+    """Cross-thread attribute writes without a lock.
+
+    A class that starts a ``threading.Thread`` on one of its own methods
+    (the feeder/watchdog pattern) shares ``self`` between threads; an
+    attribute the worker method writes *and* another method also writes
+    is a data race unless every write holds a lock. Single-writer
+    telemetry counters (worker writes, others only read) are fine and
+    not flagged; ``__init__`` writes happen before the thread starts and
+    are likewise exempt.
+    """
+
+    id = "SAV107"
+    name = "unlocked-thread-shared-state"
+    severity = "warning"
+    hint = (
+        "guard multi-writer attributes with one threading.Lock (with "
+        "self._lock: ...), or restructure so only one thread writes"
+    )
+
+    def _lockish(self, node, lock_attrs) -> bool:
+        if isinstance(node, ast.Attribute):
+            return node.attr in lock_attrs or "lock" in node.attr.lower()
+        if isinstance(node, ast.Name):
+            return "lock" in node.id.lower()
+        return False
+
+    def _method_writes(self, method, lock_attrs):
+        """(attr, node, protected) for every self.attr assignment."""
+        out = []
+
+        def visit(node, protected):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)):
+                return
+            if isinstance(node, ast.With):
+                held = protected or any(
+                    self._lockish(item.context_expr, lock_attrs)
+                    for item in node.items
+                )
+                for child in ast.iter_child_nodes(node):
+                    visit(child, held)
+                return
+            targets = []
+            if isinstance(node, ast.Assign):
+                targets = node.targets
+            elif isinstance(node, ast.AugAssign):
+                targets = [node.target]
+            for t in targets:
+                if (
+                    isinstance(t, ast.Attribute)
+                    and isinstance(t.value, ast.Name)
+                    and t.value.id == "self"
+                ):
+                    out.append((t.attr, node, protected))
+            for child in ast.iter_child_nodes(node):
+                visit(child, protected)
+
+        for stmt in method.body:
+            visit(stmt, False)
+        return out
+
+    def check(self, module):
+        for cls in ast.walk(module.tree):
+            if not isinstance(cls, ast.ClassDef):
+                continue
+            methods = [
+                n
+                for n in cls.body
+                if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
+            ]
+            workers = set()
+            lock_attrs = set()
+            for node in ast.walk(cls):
+                if isinstance(node, ast.Call):
+                    if module.resolve_call(node) == "threading.Thread":
+                        for k in node.keywords:
+                            if (
+                                k.arg == "target"
+                                and isinstance(k.value, ast.Attribute)
+                                and isinstance(k.value.value, ast.Name)
+                                and k.value.value.id == "self"
+                            ):
+                                workers.add(k.value.attr)
+                if isinstance(node, ast.Assign) and isinstance(
+                    node.value, ast.Call
+                ):
+                    resolved = module.resolve_call(node.value)
+                    if resolved in (
+                        "threading.Lock",
+                        "threading.RLock",
+                        "threading.Condition",
+                        "threading.Semaphore",
+                    ):
+                        for t in node.targets:
+                            if (
+                                isinstance(t, ast.Attribute)
+                                and isinstance(t.value, ast.Name)
+                                and t.value.id == "self"
+                            ):
+                                lock_attrs.add(t.attr)
+            if not workers:
+                continue
+            writes = {
+                m.name: self._method_writes(m, lock_attrs) for m in methods
+            }
+            writers_of: dict[str, set] = {}
+            for name, ws in writes.items():
+                if name == "__init__":
+                    continue
+                for attr, _node, _prot in ws:
+                    writers_of.setdefault(attr, set()).add(name)
+            for attr, method_names in writers_of.items():
+                if len(method_names) < 2 or not (method_names & workers):
+                    continue
+                for name in method_names:
+                    for wattr, node, protected in writes[name]:
+                        if wattr != attr or protected:
+                            continue
+                        yield _finding(
+                            self,
+                            node,
+                            f"self.{attr} is written by "
+                            f"{sorted(method_names)} while "
+                            f"{sorted(method_names & workers)} runs on its "
+                            "own thread — unlocked multi-writer state",
+                        )
+
+
+# ---------------------------------------------------------------- SAV108
+
+
+class F32LiteralPromotion(Rule):
+    """dtype-less float array constructor in a bf16 compute path.
+
+    ``jnp.zeros(shape)`` defaults to float32; under bf16 compute that
+    constant promotes every op it touches back to f32 — doubling the HBM
+    traffic the bf16 path existed to halve, invisibly (results stay
+    correct, the step just gets slower; PERF.md §6 measured the
+    [B,H,L,L] case at −15% step time). Scoped to the model/ops trees
+    where compute dtype is a parameter; int-valued ``arange`` is exempt.
+    """
+
+    id = "SAV108"
+    name = "f32-literal-promotion"
+    severity = "warning"
+    hint = (
+        "pass the computation's dtype explicitly "
+        "(jnp.zeros(shape, dtype=x.dtype) or the module's self.dtype)"
+    )
+
+    # constructor → index of the positional dtype parameter
+    CTORS = {
+        "jax.numpy.zeros": 1,
+        "jax.numpy.ones": 1,
+        "jax.numpy.empty": 1,
+        "jax.numpy.full": 2,
+    }
+
+    def check(self, module):
+        if not module.relpath.startswith(BF16_PATHS):
+            return
+        for node in ast.walk(module.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            resolved = module.resolve_call(node)
+            if resolved in self.CTORS:
+                if any(k.arg == "dtype" for k in node.keywords):
+                    continue
+                if len(node.args) > self.CTORS[resolved]:
+                    continue  # positional dtype
+                yield _finding(
+                    self,
+                    node,
+                    f"{resolved.rsplit('.', 1)[1]}() without dtype defaults "
+                    "to float32 and promotes the surrounding bf16 compute",
+                )
+            elif resolved == "jax.numpy.linspace":
+                if not any(k.arg == "dtype" for k in node.keywords):
+                    yield _finding(
+                        self,
+                        node,
+                        "linspace() without dtype defaults to float32 and "
+                        "promotes the surrounding bf16 compute",
+                    )
+            elif resolved == "jax.numpy.arange":
+                has_float = any(
+                    isinstance(a, ast.Constant) and isinstance(a.value, float)
+                    for a in node.args
+                )
+                if has_float and not any(
+                    k.arg == "dtype" for k in node.keywords
+                ) and len(node.args) < 4:
+                    yield _finding(
+                        self,
+                        node,
+                        "arange() over floats without dtype defaults to "
+                        "float32 and promotes the surrounding bf16 compute",
+                    )
+
+
+# ---------------------------------------------------------------- SAV109
+
+
+class JitInLoop(Rule):
+    """``jax.jit`` called inside a loop body.
+
+    ``jax.jit`` keys its compile cache on the *function object*: wrapping
+    a fresh lambda/closure each iteration means a cache miss — trace and
+    compile — every time around the loop. Hoist the jit outside the loop
+    (or module scope) and call the one wrapped function repeatedly.
+    """
+
+    id = "SAV109"
+    name = "jit-in-loop"
+    severity = "warning"
+    hint = "hoist the jax.jit(...) wrapping out of the loop; jit once, call many"
+
+    def check(self, module):
+        def visit(node, in_loop):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)):
+                in_loop = False
+            elif isinstance(node, (ast.For, ast.While)):
+                in_loop = True
+            elif (
+                in_loop
+                and isinstance(node, ast.Call)
+                and module.resolve_call(node) == "jax.jit"
+            ):
+                yield _finding(
+                    self,
+                    node,
+                    "jax.jit inside a loop wraps a fresh function object "
+                    "per iteration — a compile-cache miss every time",
+                )
+            for child in ast.iter_child_nodes(node):
+                yield from visit(child, in_loop)
+
+        yield from visit(module.tree, False)
+
+
+# ---------------------------------------------------------------- SAV110
+
+
+class AdhocSeedDerivation(Rule):
+    """Arithmetic on seeds instead of ``fold_in`` on a key.
+
+    ``PRNGKey(seed + 1)`` manufactures a sibling stream by poking the
+    seed — nothing stops ``seed + 1`` from colliding with another run's
+    ``seed``, and the derivation is invisible to anyone auditing key
+    lineage. ``jax.random.fold_in(run_key, tag)`` derives a
+    statistically independent stream from the run key with an explicit,
+    greppable tag (trainer.py's fit() key is the in-repo example).
+    """
+
+    id = "SAV110"
+    name = "adhoc-seed-derivation"
+    severity = "warning"
+    hint = (
+        "derive from the run key: jax.random.fold_in("
+        "jax.random.PRNGKey(seed), tag)"
+    )
+
+    def check(self, module):
+        for node in ast.walk(module.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            if module.resolve_call(node) != "jax.random.PRNGKey":
+                continue
+            if node.args and isinstance(node.args[0], ast.BinOp):
+                yield _finding(
+                    self,
+                    node,
+                    "PRNGKey over seed arithmetic — derive sibling streams "
+                    "with fold_in on the run key, not by perturbing the seed",
+                )
+
+
+# ----------------------------------------------------------- SAV100 (meta)
+
+
+class _PragmaHygiene(Rule):
+    """Suppressions must name real rules and record a justification.
+
+    A ``# savlint: disable=...`` with no ``-- reason`` (or an unknown
+    rule id) defeats the audit trail the pragma system exists for; this
+    meta-rule makes such pragmas findings themselves, and cannot be
+    pragma'd away.
+    """
+
+    id = "SAV100"
+    name = "pragma-hygiene"
+    severity = "error"
+    hint = "write '# savlint: disable=<RULE-ID> -- one-line justification'"
+
+
+_PRAGMA_HYGIENE = _PragmaHygiene()
+
+
+def check_pragma_hygiene(module: ModuleInfo) -> list[Finding]:
+    findings = []
+    known = {r.id for r in ALL_RULES} | {"SAV001"}
+    for p in module.pragmas:
+        unknown = sorted(p.rules - known)
+        if unknown:
+            findings.append(
+                _finding(
+                    _PRAGMA_HYGIENE,
+                    type("L", (), {"lineno": p.line, "col_offset": 0,
+                                   "end_lineno": p.line})(),
+                    f"pragma names unknown rule(s) {', '.join(unknown)}",
+                    code=module.function_source_line(p.line),
+                )
+            )
+        if not p.justification:
+            findings.append(
+                _finding(
+                    _PRAGMA_HYGIENE,
+                    type("L", (), {"lineno": p.line, "col_offset": 0,
+                                   "end_lineno": p.line})(),
+                    "pragma has no justification — every suppression must "
+                    "say why the violation is intentional",
+                    code=module.function_source_line(p.line),
+                )
+            )
+    return findings
+
+
+ALL_RULES = [
+    HostSyncInHotLoop(),
+    JitWithoutDonation(),
+    PrngKeyReuse(),
+    PythonScalarArgRetrace(),
+    TimeInJit(),
+    InlineDevicePutInFit(),
+    UnlockedThreadSharedState(),
+    F32LiteralPromotion(),
+    JitInLoop(),
+    AdhocSeedDerivation(),
+]
+
+
+def rule_catalog() -> list[dict]:
+    """Machine-readable rule table (CLI --list-rules, docs generation)."""
+    catalog = [
+        {
+            "id": r.id,
+            "name": r.name,
+            "severity": r.severity,
+            "summary": (r.__doc__ or "").strip().splitlines()[0],
+            "hint": r.hint,
+        }
+        for r in [_PRAGMA_HYGIENE] + ALL_RULES
+    ]
+    return catalog

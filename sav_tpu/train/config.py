@@ -152,6 +152,15 @@ class TrainConfig:
     # no step completes within this many seconds (None disables). Armed
     # after the first step so compile time cannot false-fire it.
     watchdog_secs: Optional[float] = None
+    # Runtime sanitizers (sav_tpu.analysis.sanitize;
+    # docs/static_analysis.md): after the first completed step, arm
+    # jax.transfer_guard_host_to_device("disallow") on the training
+    # thread (an implicit host->device transfer in the hot loop raises —
+    # the feeder's explicit device_puts on its own thread are exempt)
+    # and hard-fail the run the moment the jitted step re-traces
+    # (RetraceSanitizerError names the step; diagnostics' retrace
+    # metric only reports at the next log window).
+    sanitize: bool = False
 
     @property
     def steps_per_epoch(self) -> int:

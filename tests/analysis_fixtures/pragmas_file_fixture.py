@@ -1,0 +1,9 @@
+"""File-scope pragma: every SAV110 below is suppressed at once."""
+# savlint: disable-file=SAV110 -- fixture: sweeping a legacy file wholesale
+import jax
+
+
+def streams(seed):
+    a = jax.random.PRNGKey(seed + 1)
+    b = jax.random.PRNGKey(seed + 2)
+    return a, b

@@ -1,0 +1,12 @@
+"""Pragma mechanics: suppression, mandatory justification, unknown ids."""
+import jax
+
+
+def fit(self, train_iter):
+    state = self.state
+    for batch in train_iter:
+        state, metrics = self.step(state, batch)
+        loss = jax.device_get(metrics)  # savlint: disable=SAV101 -- fixture: justified suppression
+        bad = jax.device_get(metrics)  # savlint: disable=SAV101
+        other = jax.device_get(metrics)  # savlint: disable=SAV999 -- unknown rule id
+    return state, loss, bad, other

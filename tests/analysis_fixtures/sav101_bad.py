@@ -1,0 +1,21 @@
+"""Known-bad: host syncs inside hot-path functions (SAV101)."""
+import jax
+import numpy as np
+
+
+def fit(self, train_iter):
+    state = self.state
+    for batch in train_iter:
+        state, metrics = self.step(state, batch)
+        loss = jax.device_get(metrics["loss"])  # line 10: device_get
+        jax.block_until_ready(state)  # line 11: block_until_ready fn
+        acc = metrics["acc"].item()  # line 12: .item() method
+        arr = np.asarray(metrics["grads"])  # line 13: np.asarray
+        lr = float(metrics["lr"])  # line 14: float(subscript)
+        state.params.block_until_ready()  # line 15: method sync
+    return state, loss, acc, arr, lr
+
+
+def evaluate(self, eval_iter):
+    sums = [self.eval_step(b) for b in eval_iter]
+    return [s.item() for s in sums]  # line 21: .item() in evaluate

@@ -1,0 +1,21 @@
+"""Known-clean for SAV101: syncs outside the hot path don't fire."""
+import jax
+
+
+def fit(self, train_iter):
+    state = self.state
+    for batch in train_iter:
+        state, metrics = self.step(state, batch)
+        self.history.append(metrics)  # stays on device
+    return state
+
+
+def summarize(history):
+    # Not a hot function: a post-run sync is fine.
+    return [float(jax.device_get(m["loss"])) for m in history]
+
+
+def report(metrics):
+    # float() of a bare name is not flagged (too ambiguous statically).
+    v = metrics
+    return float(v)

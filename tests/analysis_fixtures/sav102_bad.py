@@ -1,0 +1,23 @@
+"""Known-bad: state-carrying jits without donation (SAV102)."""
+from functools import partial
+
+import jax
+
+
+def train_step_impl(state, batch, rng):
+    return state, {}
+
+
+class Trainer:
+    def __init__(self):
+        self._train_step = jax.jit(train_step_impl)  # line 13: no donation
+
+
+@jax.jit  # line 16: bare decorator cannot donate
+def update(state, grads):
+    return state
+
+
+@partial(jax.jit)  # line 21: partial form, donation forgotten
+def apply_updates(state, updates):
+    return state

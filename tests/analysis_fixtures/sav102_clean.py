@@ -1,0 +1,29 @@
+"""Known-clean for SAV102: donation present, or exempt by role."""
+from functools import partial
+
+import jax
+
+
+def train_step_impl(state, batch, rng):
+    return state, {}
+
+
+def eval_step_impl(state, batch):
+    # eval reuses state across batches — donating it would crash.
+    return {}
+
+
+def init_fn(rng):
+    return rng
+
+
+class Trainer:
+    def __init__(self):
+        self._train_step = jax.jit(train_step_impl, donate_argnums=(0,))
+        self._eval_step = jax.jit(eval_step_impl)
+        self._init = jax.jit(init_fn)
+
+
+@partial(jax.jit, donate_argnums=(0,))
+def update(state, grads):
+    return state

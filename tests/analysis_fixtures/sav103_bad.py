@@ -1,0 +1,15 @@
+"""Known-bad: the same PRNG key consumed twice (SAV103)."""
+import jax
+
+
+def sample(key, shape):
+    noise = jax.random.normal(key, shape)
+    mask = jax.random.bernoulli(key, 0.5, shape)  # line 7: key reused
+    return noise, mask
+
+
+def augment(rng, images, labels):
+    k = jax.random.fold_in(rng, 7)  # deriving is fine
+    perm = jax.random.permutation(k, labels.shape[0])
+    ratio = jax.random.uniform(k)  # line 14: k reused
+    return perm, ratio

@@ -1,0 +1,22 @@
+"""Known-clean for SAV103: split/fold_in per consumer, reassignment resets."""
+import jax
+
+
+def sample(key, shape):
+    k_noise, k_mask = jax.random.split(key)
+    noise = jax.random.normal(k_noise, shape)
+    mask = jax.random.bernoulli(k_mask, 0.5, shape)
+    return noise, mask
+
+
+def loop_body(rng, xs):
+    for i, x in enumerate(xs):
+        step_key = jax.random.fold_in(rng, i)  # derive per step: fine
+        yield jax.random.normal(step_key, x.shape)
+
+
+def reassigned(key, shape):
+    a = jax.random.normal(key, shape)
+    key = jax.random.fold_in(key, 1)  # reassignment resets the count
+    b = jax.random.normal(key, shape)
+    return a, b

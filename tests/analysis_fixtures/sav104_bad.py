@@ -1,0 +1,16 @@
+"""Known-bad: Python loop counter passed into jitted calls (SAV104)."""
+import jax
+
+step = jax.jit(lambda state, n: state)
+
+
+def run(state):
+    for i in range(100):
+        state = step(state, i)  # line 9: loop var straight into jit
+    for j, batch in enumerate(load()):
+        state = step(state, j * 2)  # line 11: BinOp of the counter
+    return state
+
+
+def load():
+    return []

@@ -1,0 +1,13 @@
+"""Known-clean for SAV104: counters on device or in the data."""
+import jax
+import jax.numpy as jnp
+
+step = jax.jit(lambda state, batch: state)
+
+
+def run(state, batches):
+    for batch in batches:  # data loop var is the normal pattern
+        state = step(state, batch)
+    for i in range(10):
+        state = step(state, jnp.float32(i))  # wrapped: arrives as array
+    return state

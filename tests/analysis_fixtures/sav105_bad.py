@@ -1,0 +1,21 @@
+"""Known-bad: wall-clock reads inside jit-traced code (SAV105)."""
+import time
+from datetime import datetime
+
+import jax
+
+
+@jax.jit
+def timed_step(x, batch):
+    t0 = time.time()  # line 10: frozen at trace time
+    x = x + batch
+    elapsed = time.perf_counter() - t0  # line 12: same
+    stamp = datetime.now()  # line 13: same
+    return x, elapsed, stamp
+
+
+def step_impl(x):
+    return x, time.monotonic()  # line 18: jitted via jax.jit below
+
+
+wrapped = jax.jit(step_impl)

@@ -1,0 +1,19 @@
+"""Known-bad: inline device placement in fit()/evaluate() (SAV106)."""
+import jax
+
+
+class Trainer:
+    def fit(self, train_iter):
+        state = self.state
+        for batch in train_iter:
+            placed = jax.device_put(batch)  # line 9: inline placement
+            sharded = self.shard_batch(batch)  # line 10: same via helper
+            state, _ = self.step(state, placed or sharded)
+        return state
+
+    def evaluate(self, eval_iter):
+        sums = []
+        for batch in eval_iter:
+            placed = self.shard_batch(batch)  # line 17: eval is hot too
+            sums.append(self.eval_step(placed))
+        return sums

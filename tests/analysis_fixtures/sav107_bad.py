@@ -1,0 +1,18 @@
+"""Known-bad: unlocked multi-writer state across threads (SAV107)."""
+import threading
+
+
+class Pipeline:
+    def __init__(self):
+        self.count = 0
+        self.status = "idle"
+        self._thread = threading.Thread(target=self._worker)
+
+    def _worker(self):
+        while True:
+            self.count += 1  # line 13: worker writes...
+            self.status = "running"  # line 14: worker writes...
+
+    def reset(self):
+        self.count = 0  # line 17: ...and so does another thread
+        self.status = "idle"  # line 18: ...unlocked both sides

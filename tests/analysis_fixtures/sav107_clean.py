@@ -1,0 +1,32 @@
+"""Known-clean for SAV107: locked writes, or a single writer."""
+import threading
+
+
+class LockedPipeline:
+    def __init__(self):
+        self.count = 0
+        self._lock = threading.Lock()
+        self._thread = threading.Thread(target=self._worker)
+
+    def _worker(self):
+        while True:
+            with self._lock:
+                self.count += 1
+
+    def reset(self):
+        with self._lock:
+            self.count = 0
+
+
+class SingleWriter:
+    def __init__(self):
+        self.fetched = 0
+        self.consumed = 0
+        self._thread = threading.Thread(target=self._worker)
+
+    def _worker(self):
+        self.fetched += 1  # only the worker writes this: fine
+
+    def take(self):
+        self.consumed += 1  # only the consumer writes this: fine
+        return self.fetched  # cross-thread *reads* are not flagged

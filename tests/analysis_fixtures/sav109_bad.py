@@ -1,0 +1,10 @@
+"""Known-bad: jax.jit wrapped inside the loop (SAV109)."""
+import jax
+
+
+def sweep(shapes, x):
+    results = []
+    for shape in shapes:
+        fn = jax.jit(lambda v: v.reshape(shape))  # line 8: jit per iteration
+        results.append(fn(x))
+    return results

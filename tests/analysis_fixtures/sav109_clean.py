@@ -1,0 +1,21 @@
+"""Known-clean for SAV109: jit once outside, call many inside."""
+import jax
+
+
+@jax.jit
+def fn(v):
+    return v * 2
+
+
+def sweep(xs):
+    return [fn(x) for x in xs]
+
+
+def make_runner():
+    for _ in range(1):
+        pass
+
+    def run(x):  # a def in a function is fine; the jit is outside loops
+        return jax.jit(lambda v: v)(x)
+
+    return run

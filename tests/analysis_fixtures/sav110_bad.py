@@ -1,0 +1,8 @@
+"""Known-bad: seed arithmetic instead of fold_in (SAV110)."""
+import jax
+
+
+def make_streams(seed):
+    train_rng = jax.random.PRNGKey(seed + 1)  # line 6: seed arithmetic
+    eval_rng = jax.random.PRNGKey(2 * seed)  # line 7: seed arithmetic
+    return train_rng, eval_rng

@@ -1,0 +1,9 @@
+"""Known-clean for SAV110: sibling streams derived with fold_in."""
+import jax
+
+
+def make_streams(seed):
+    run_key = jax.random.PRNGKey(seed)
+    train_rng = jax.random.fold_in(run_key, 1)
+    eval_rng = jax.random.fold_in(run_key, 2)
+    return train_rng, eval_rng

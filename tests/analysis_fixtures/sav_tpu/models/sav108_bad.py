@@ -1,0 +1,9 @@
+"""Known-bad: dtype-less f32 constructors in a bf16 path (SAV108)."""
+import jax.numpy as jnp
+
+
+def position_table(length, dim):
+    table = jnp.zeros((length, dim))  # line 6: f32 default
+    ramp = jnp.linspace(0.0, 1.0, length)  # line 7: f32 default
+    steps = jnp.arange(0.0, 1.0, 0.1)  # line 8: float arange
+    return table + ramp[:, None] + steps.sum()

@@ -1,0 +1,10 @@
+"""Known-clean for SAV108: explicit dtype, positional dtype, int arange."""
+import jax.numpy as jnp
+
+
+def position_table(length, dim, dtype):
+    table = jnp.zeros((length, dim), dtype=dtype)
+    mask = jnp.ones((length,), jnp.int32)  # positional dtype
+    idx = jnp.arange(length)  # int arange defaults to int: fine
+    ramp = jnp.linspace(0.0, 1.0, length, dtype=dtype)
+    return table, mask, idx, ramp

@@ -1,0 +1,180 @@
+"""Runtime sanitizers (ISSUE 3): StepSanitizer unit + Trainer integration.
+
+Unit tier: the retrace arm catches both retrace seeds (shape drift,
+static-arg drift) the moment they happen; the transfer arm rejects
+implicit host→device transfers while armed and unwinds cleanly on
+close. Integration tier: ``TrainConfig.sanitize=True`` is silent on a
+healthy run (the acceptance criterion for ``train.py --sanitize``),
+composes with the feeder, the serial fallback, and diagnostics — and a
+seeded retrace mid-fit fails loudly with the step number in the error.
+"""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+from sav_tpu.analysis.sanitize import RetraceSanitizerError, StepSanitizer
+from sav_tpu.obs.memory import RetraceCounter
+
+
+# -------------------------------------------------------------- unit tier
+
+
+def test_retrace_on_shape_drift_caught_at_the_offending_step():
+    f = jax.jit(lambda x: x * 2)
+    san = StepSanitizer(f, transfer_guard=None)
+    f(jnp.ones(4))
+    san.arm()  # warmup trace forgiven
+    f(jnp.ones(4))
+    san.check(2)  # cache hit: silent
+    f(jnp.ones(5))  # shape drift: new trace
+    with pytest.raises(RetraceSanitizerError, match="step 3"):
+        san.check(3)
+    san.close()
+
+
+def test_retrace_on_static_scalar_drift():
+    g = jax.jit(lambda x, n: x[:n], static_argnums=1)
+    san = StepSanitizer(g, transfer_guard=None)
+    x = jnp.ones(8)
+    g(x, 4)
+    san.arm()
+    g(x, 4)
+    san.check(2)
+    g(x, 5)  # distinct static value: one program per value
+    with pytest.raises(RetraceSanitizerError, match="re-traced 1x"):
+        san.check(3)
+    san.close()
+
+
+def test_transfer_guard_blocks_implicit_h2d_until_close():
+    f = jax.jit(lambda x: x + 1)
+    placed = jnp.ones(4)
+    san = StepSanitizer(f)
+    f(placed)
+    san.arm()
+    f(placed)  # device-resident arg: fine
+    # Explicit placement stays legal — the feeder/serial-fallback contract.
+    f(jax.device_put(np.ones(4)))
+    with pytest.raises(Exception, match="[Dd]isallow"):
+        f(np.ones(4))  # implicit host->device upload
+    san.close()
+    f(np.ones(4))  # guard unwound
+
+
+def test_sanitizer_is_idempotent_and_safe_unarmed():
+    f = jax.jit(lambda x: x)
+    san = StepSanitizer(f)
+    san.check(1)  # before arm: no-op
+    san.close()  # before arm: no-op
+    san.arm()
+    san.arm()  # double-arm: no double guard entry
+    san.close()
+    san.close()
+    assert san.active  # counter works on this jax
+
+
+def test_sanitizer_counter_is_independent_of_a_diagnostics_counter():
+    """The trainer runs diagnostics' RetraceCounter and the sanitizer's
+    side by side on one jitted fn; each holds its own watermark, so
+    neither steals the other's delta."""
+    f = jax.jit(lambda x: x)
+    a, b = RetraceCounter(f), RetraceCounter(f)
+    f(jnp.ones(3))
+    assert a.delta() == 1
+    assert b.delta() == 1  # a's read did not consume b's view
+    f(jnp.ones(4))
+    assert b.delta() == 1
+    assert a.delta() == 1
+
+
+# ------------------------------------------------------- integration tier
+
+
+def _trainer(**config_overrides):
+    from sav_tpu.models import create_model
+    from sav_tpu.train import TrainConfig, Trainer
+
+    base = dict(
+        model_name="vit_ti_patch16",
+        num_classes=10,
+        image_size=32,
+        compute_dtype="float32",
+        global_batch_size=16,
+        num_train_images=16 * 4,
+        num_epochs=2,
+        warmup_epochs=1,
+        lr_scaling_divisor=16,
+        transpose_images=False,
+        log_every_steps=2,
+        sanitize=True,
+        seed=0,
+    )
+    base.update(config_overrides)
+    config = TrainConfig(**base)
+    model = create_model(
+        config.model_name, num_classes=config.num_classes,
+        dtype=jnp.float32, num_layers=2, embed_dim=64, num_heads=4,
+    )
+    return Trainer(config, model=model)
+
+
+def _batches(n, batch_size=16, seed=0):
+    rng = np.random.default_rng(seed)
+    return [
+        {
+            "images": rng.standard_normal(
+                (batch_size, 32, 32, 3)
+            ).astype(np.float32),
+            "labels": rng.integers(0, 10, (batch_size,), np.int32),
+        }
+        for _ in range(n)
+    ]
+
+
+def test_fit_with_sanitize_completes_silently(devices):
+    """The acceptance path behind `train.py --sanitize`: a healthy run
+    (async feeder on) finishes with guards armed and nothing fired."""
+    trainer = _trainer()
+    state, history = trainer.fit(iter(_batches(4)), num_steps=4)
+    assert int(jax.device_get(state.step)) == 4
+    assert trainer.last_goodput["gauges"]["feeder/batches"] == 4.0
+
+
+def test_fit_with_sanitize_serial_fallback(devices):
+    """async_feed=False places batches inline but EXPLICITLY — the
+    transfer guard must accept the sanctioned serial path too."""
+    trainer = _trainer(async_feed=False)
+    state, _ = trainer.fit(iter(_batches(3)), num_steps=3)
+    assert int(jax.device_get(state.step)) == 3
+
+
+def test_fit_with_sanitize_and_diagnostics_coexist(devices):
+    """Two RetraceCounters on one step fn (diagnostics' + the
+    sanitizer's) must not steal each other's deltas."""
+    trainer = _trainer(diagnostics=True)
+    state, history = trainer.fit(iter(_batches(4)), num_steps=4)
+    assert int(jax.device_get(state.step)) == 4
+    logged = [h for h in history if "retraces" in h]
+    assert logged and all(h["retraces"] == 0.0 for h in logged)
+
+
+def test_fit_seeded_retrace_fails_loudly(devices):
+    """A batch whose shape drifts mid-run re-traces the step; with
+    sanitize on that is a hard error naming the step, not a silently
+    slower run."""
+    batches = _batches(2) + _batches(1, batch_size=8)
+    trainer = _trainer()
+    with pytest.raises(RetraceSanitizerError, match="step 3"):
+        trainer.fit(iter(batches), num_steps=3)
+
+
+def test_fit_without_sanitize_tolerates_the_same_drift(devices):
+    """Control: the drift above is only fatal when asked for — default
+    runs keep the old permissive behavior (retrace telemetry reports,
+    nothing raises)."""
+    batches = _batches(2) + _batches(1, batch_size=8)
+    trainer = _trainer(sanitize=False)
+    state, _ = trainer.fit(iter(batches), num_steps=3)
+    assert int(jax.device_get(state.step)) == 3

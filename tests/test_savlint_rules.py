@@ -1,0 +1,276 @@
+"""savlint rule semantics (ISSUE 3): every rule against its fixture pair.
+
+Each rule has a known-bad fixture (exact rule IDs *and line numbers*
+asserted — a rule that fires on the wrong line sends a human to the
+wrong code) and a known-clean fixture holding the nearest legitimate
+idioms (exactly zero findings — false positives are what kill linters).
+Plus the suppression machinery itself: line pragmas, file pragmas, the
+mandatory justification (SAV100), and the baseline.
+"""
+
+import json
+import os
+
+import pytest
+
+from sav_tpu.analysis.lint import (
+    Finding,
+    lint_file,
+    lint_paths,
+    load_baseline,
+    write_baseline,
+)
+from sav_tpu.analysis.rules import ALL_RULES, rule_catalog
+
+FIXTURES = os.path.join(os.path.dirname(__file__), "analysis_fixtures")
+
+
+def fixture_findings(name, suppressed=False):
+    path = os.path.join(FIXTURES, name)
+    found = lint_file(path, root=FIXTURES)
+    if suppressed:
+        return found
+    return [f for f in found if f.suppressed_by is None]
+
+
+BAD_EXPECTATIONS = {
+    "sav101_bad.py": [
+        ("SAV101", 10),  # jax.device_get
+        ("SAV101", 11),  # jax.block_until_ready
+        ("SAV101", 12),  # .item()
+        ("SAV101", 13),  # np.asarray
+        ("SAV101", 14),  # float(subscript)
+        ("SAV101", 15),  # .block_until_ready()
+        ("SAV101", 21),  # .item() in evaluate()
+    ],
+    "sav102_bad.py": [
+        ("SAV102", 13),  # jax.jit(train_step_impl) without donation
+        ("SAV102", 16),  # bare @jax.jit on a state-carrying fn
+        ("SAV102", 21),  # @partial(jax.jit) with donation forgotten
+    ],
+    "sav103_bad.py": [
+        ("SAV103", 7),  # key consumed by normal then bernoulli
+        ("SAV103", 14),  # derived key consumed twice
+    ],
+    "sav104_bad.py": [
+        ("SAV104", 9),  # range() counter straight into a jitted call
+        ("SAV104", 11),  # BinOp of an enumerate() counter
+    ],
+    "sav105_bad.py": [
+        ("SAV105", 10),  # time.time() under @jax.jit
+        ("SAV105", 12),  # time.perf_counter()
+        ("SAV105", 13),  # datetime.now()
+        ("SAV105", 18),  # fn registered jitted via jax.jit(step_impl)
+    ],
+    "sav106_bad.py": [
+        ("SAV106", 9),  # jax.device_put in fit()
+        ("SAV106", 10),  # shard_batch in fit()
+        ("SAV106", 17),  # shard_batch in evaluate()
+    ],
+    "sav107_bad.py": [
+        ("SAV107", 13),  # worker += on shared attr
+        ("SAV107", 14),  # worker assign on shared attr
+        ("SAV107", 17),  # consumer assign on the same attrs
+        ("SAV107", 18),
+    ],
+    "sav_tpu/models/sav108_bad.py": [
+        ("SAV108", 6),  # dtype-less zeros
+        ("SAV108", 7),  # dtype-less linspace
+        ("SAV108", 8),  # float arange
+    ],
+    "sav109_bad.py": [
+        ("SAV109", 8),  # jax.jit per loop iteration
+    ],
+    "sav110_bad.py": [
+        ("SAV110", 6),  # PRNGKey(seed + 1)
+        ("SAV110", 7),  # PRNGKey(2 * seed)
+    ],
+}
+
+CLEAN_FIXTURES = [
+    "sav101_clean.py",
+    "sav102_clean.py",
+    "sav103_clean.py",
+    "sav104_clean.py",
+    "sav105_clean.py",
+    "sav106_clean.py",
+    "sav107_clean.py",
+    "sav_tpu/models/sav108_clean.py",
+    "sav109_clean.py",
+    "sav110_clean.py",
+]
+
+
+@pytest.mark.parametrize("name", sorted(BAD_EXPECTATIONS))
+def test_known_bad_fixture_exact_rules_and_lines(name):
+    got = [(f.rule, f.line) for f in fixture_findings(name)]
+    assert got == BAD_EXPECTATIONS[name]
+
+
+@pytest.mark.parametrize("name", CLEAN_FIXTURES)
+def test_known_clean_fixture_has_zero_findings(name):
+    assert fixture_findings(name) == []
+
+
+def test_every_rule_has_a_fixture_pair():
+    """A rule without fixtures is a rule whose regressions are invisible."""
+    covered = {rule for findings in BAD_EXPECTATIONS.values()
+               for rule, _ in findings}
+    assert covered == {r.id for r in ALL_RULES}
+
+
+def test_severity_and_hint_attached():
+    by_id = {r.id: r for r in ALL_RULES}
+    for f in fixture_findings("sav101_bad.py") + fixture_findings(
+        "sav102_bad.py"
+    ):
+        assert f.severity == by_id[f.rule].severity
+        assert f.hint  # every finding tells the reader how to fix it
+        assert f.code  # and shows the offending line
+
+
+# ----------------------------------------------------------- suppression
+
+
+def test_line_pragma_suppresses_and_requires_justification():
+    found = fixture_findings("pragmas_fixture.py", suppressed=True)
+    by = {(f.rule, f.line): f for f in found}
+    # Justified pragma: SAV101 suppressed, no SAV100.
+    assert by[("SAV101", 9)].suppressed_by == "pragma"
+    # Unjustified pragma: suppression still applies (the author's intent
+    # is clear) but pragma hygiene makes the missing reason a finding.
+    assert by[("SAV101", 10)].suppressed_by == "pragma"
+    assert by[("SAV100", 10)].suppressed_by is None
+    # Unknown rule id: no suppression of the real finding + hygiene error.
+    assert by[("SAV101", 11)].suppressed_by is None
+    assert by[("SAV100", 11)].suppressed_by is None
+
+
+def test_file_pragma_suppresses_whole_file():
+    found = fixture_findings("pragmas_file_fixture.py", suppressed=True)
+    assert [(f.rule, f.suppressed_by) for f in found] == [
+        ("SAV110", "pragma"),
+        ("SAV110", "pragma"),
+    ]
+
+
+def test_baseline_roundtrip_suppresses_exactly_counted_findings(tmp_path):
+    baseline = str(tmp_path / "baseline.json")
+    bad = os.path.join(FIXTURES, "sav110_bad.py")
+    first = lint_paths([bad], root=FIXTURES)
+    assert len(first.findings) == 2
+    n = write_baseline(baseline, first.findings)
+    assert n == 2  # distinct source lines -> distinct entries
+    entries = load_baseline(baseline)
+    assert all(e["justification"].startswith("TODO") for e in entries)
+    again = lint_paths([bad], root=FIXTURES, baseline=baseline)
+    assert again.findings == []
+    assert [f.suppressed_by for f in again.suppressed] == ["baseline"] * 2
+
+
+def test_baseline_count_does_not_absorb_new_duplicates(tmp_path):
+    """count=1 in the baseline grandfathers ONE occurrence; a copy-pasted
+    second violation on an identical line still fails."""
+    baseline = str(tmp_path / "baseline.json")
+    src = tmp_path / "dup.py"
+    src.write_text(
+        "import jax\n\n\ndef make(seed):\n"
+        "    a = jax.random.PRNGKey(seed + 1)\n"
+        "    return a\n"
+    )
+    res = lint_paths([str(src)], root=str(tmp_path))
+    write_baseline(baseline, res.findings)
+    src.write_text(
+        "import jax\n\n\ndef make(seed):\n"
+        "    a = jax.random.PRNGKey(seed + 1)\n"
+        "    b = jax.random.PRNGKey(seed + 1)\n"
+        "    return a, b\n"
+    )
+    res2 = lint_paths([str(src)], root=str(tmp_path), baseline=baseline)
+    assert [(f.rule, f.line) for f in res2.findings] == [("SAV110", 6)]
+
+
+def test_rewrite_preserves_existing_entries_and_justifications(tmp_path):
+    """--write-baseline must not orphan earlier grandfathered findings:
+    re-snapshotting (un-baselined, as the CLI does) keeps surviving
+    entries AND their hand-edited justifications; entries whose
+    violation was fixed fall out."""
+    baseline = str(tmp_path / "baseline.json")
+    src = tmp_path / "mix.py"
+    src.write_text(
+        "import jax\n\n\ndef make(seed):\n"
+        "    a = jax.random.PRNGKey(seed + 1)\n"
+        "    b = jax.random.PRNGKey(seed + 2)\n"
+        "    return a, b\n"
+    )
+    write_baseline(baseline, lint_paths([str(src)], root=str(tmp_path)).findings)
+    entries = load_baseline(baseline)
+    entries[0]["justification"] = "legacy stream, migrating in PR 9"
+    with open(baseline, "w") as f:
+        json.dump({"version": 1, "entries": entries}, f)
+    # One violation fixed, the justified one still present.
+    src.write_text(
+        "import jax\n\n\ndef make(seed):\n"
+        "    a = jax.random.PRNGKey(seed + 1)\n"
+        "    b = jax.random.fold_in(jax.random.PRNGKey(seed), 2)\n"
+        "    return a, b\n"
+    )
+    unbaselined = lint_paths([str(src)], root=str(tmp_path))
+    write_baseline(baseline, unbaselined.findings)
+    rewritten = load_baseline(baseline)
+    assert len(rewritten) == 1
+    assert rewritten[0]["code"] == "a = jax.random.PRNGKey(seed + 1)"
+    assert rewritten[0]["justification"] == "legacy stream, migrating in PR 9"
+    assert lint_paths(
+        [str(src)], root=str(tmp_path), baseline=baseline
+    ).findings == []
+
+
+def test_pragma_text_inside_strings_is_inert(tmp_path):
+    """Only real # comments arm suppression: quoting the syntax in a
+    docstring (as this repo's own modules do) must not suppress."""
+    src = tmp_path / "documented.py"
+    src.write_text(
+        '"""Docs quote the syntax: # savlint: disable-file=SAV110 -- example."""\n'
+        "import jax\n\n\ndef make(seed):\n"
+        "    return jax.random.PRNGKey(seed + 1)\n"
+    )
+    res = lint_paths([str(src)], root=str(tmp_path))
+    assert [(f.rule, f.line) for f in res.findings] == [("SAV110", 6)]
+
+
+# ---------------------------------------------------------------- plumbing
+
+
+def test_select_and_ignore_filter_rules():
+    bad = os.path.join(FIXTURES, "sav101_bad.py")
+    only = lint_paths([bad], root=FIXTURES, select=["SAV101"])
+    assert {f.rule for f in only.findings} == {"SAV101"}
+    none = lint_paths([bad], root=FIXTURES, ignore=["SAV101"])
+    assert none.findings == []
+
+
+def test_syntax_error_is_a_finding_not_a_crash(tmp_path):
+    src = tmp_path / "broken.py"
+    src.write_text("def f(:\n")
+    res = lint_paths([str(src)], root=str(tmp_path))
+    assert [f.rule for f in res.findings] == ["SAV001"]
+    assert res.findings[0].severity == "error"
+
+
+def test_rule_catalog_is_complete():
+    cat = {r["id"]: r for r in rule_catalog()}
+    assert set(cat) == {r.id for r in ALL_RULES} | {"SAV100"}
+    for r in cat.values():
+        assert r["summary"] and r["hint"] and r["severity"] in (
+            "error", "warning",
+        )
+
+
+def test_finding_json_shape():
+    f = fixture_findings("sav110_bad.py")[0]
+    d = json.loads(json.dumps(f.to_dict()))
+    assert d["rule"] == "SAV110" and d["line"] == 6 and d["path"].endswith(
+        "sav110_bad.py"
+    )
+    assert isinstance(f, Finding)

@@ -1,0 +1,183 @@
+#!/usr/bin/env python
+"""savlint CLI — TPU/JAX-aware static analysis over this repo.
+
+Thin argparse front over :mod:`sav_tpu.analysis.lint` (stdlib-only: no
+jax import, runs anywhere). The canonical self-run, the one tier-1
+enforces (tests/test_savlint_self.py):
+
+    python tools/savlint.py sav_tpu tools train.py bench.py
+
+Exit codes (stable — external CI keys on them):
+  0  clean: no unsuppressed findings
+  1  findings: at least one unsuppressed violation (printed, or in the
+     --json payload)
+  2  usage/internal error (bad path, unreadable baseline, bad rule id)
+
+Suppression, in preference order (docs/static_analysis.md):
+  - fix the violation;
+  - ``# savlint: disable=SAV101 -- why`` on the flagged statement
+    (justification mandatory — SAV100 fires without one);
+  - a baseline entry (``--write-baseline`` grandfathers the current
+    findings; edit in real justifications before committing).
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+# Runnable as `python tools/savlint.py` from the repo root without an
+# install step: put the checkout on sys.path like the other tools do.
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from sav_tpu.analysis.lint import (  # noqa: E402
+    DEFAULT_BASELINE,
+    lint_paths,
+    repo_root,
+    write_baseline,
+)
+from sav_tpu.analysis.rules import rule_catalog  # noqa: E402
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="savlint", description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter,
+    )
+    parser.add_argument(
+        "paths", nargs="*",
+        help="files/directories to lint (default: sav_tpu tools train.py "
+        "bench.py relative to the repo root)",
+    )
+    parser.add_argument(
+        "--json", action="store_true",
+        help="emit findings as JSON (includes suppressed, for audits)",
+    )
+    parser.add_argument(
+        "--baseline", default=DEFAULT_BASELINE,
+        help="baseline file of grandfathered findings "
+        "(default: sav_tpu/analysis/baseline.json)",
+    )
+    parser.add_argument(
+        "--no-baseline", action="store_true",
+        help="ignore the baseline: report everything, suppressed or not",
+    )
+    parser.add_argument(
+        "--write-baseline", action="store_true",
+        help="grandfather the current unsuppressed findings into --baseline "
+        "and exit 0; edit in justifications before committing",
+    )
+    parser.add_argument(
+        "--select", default=None,
+        help="comma-separated rule ids to run (default: all)",
+    )
+    parser.add_argument(
+        "--ignore", default=None,
+        help="comma-separated rule ids to skip",
+    )
+    parser.add_argument(
+        "--root", default=None,
+        help="path findings are reported relative to (default: repo root)",
+    )
+    parser.add_argument(
+        "--list-rules", action="store_true",
+        help="print the rule catalogue and exit",
+    )
+    args = parser.parse_args(argv)
+
+    if args.list_rules:
+        for r in rule_catalog():
+            print(f"{r['id']}  {r['severity']:<7}  {r['name']}")
+            print(f"        {r['summary']}")
+            print(f"        fix: {r['hint']}")
+        return 0
+
+    root = args.root or repo_root()
+    paths = args.paths or [
+        os.path.join(root, p) for p in ("sav_tpu", "tools", "train.py", "bench.py")
+    ]
+    known = {r["id"] for r in rule_catalog()}
+    for opt in (args.select, args.ignore):
+        if opt:
+            bad = {r.strip().upper() for r in opt.split(",")} - known
+            if bad:
+                print(f"savlint: unknown rule id(s): {', '.join(sorted(bad))}",
+                      file=sys.stderr)
+                return 2
+    if args.write_baseline and (args.select or args.ignore):
+        # A filtered run only sees the selected rules' findings; writing
+        # that snapshot would delete every other rule's grandfathered
+        # entries as if their violations were fixed.
+        print(
+            "savlint: --write-baseline snapshots ALL rules; drop "
+            "--select/--ignore",
+            file=sys.stderr,
+        )
+        return 2
+    if (
+        not args.no_baseline
+        and not args.write_baseline
+        and args.baseline != DEFAULT_BASELINE
+        and not os.path.exists(args.baseline)
+    ):
+        # The default baseline may legitimately be absent (fresh tree);
+        # an explicitly named one that is missing is a typo, and running
+        # without it would resurface every grandfathered finding with no
+        # hint why.
+        print(f"savlint: baseline not found: {args.baseline}", file=sys.stderr)
+        return 2
+    missing = [p for p in paths if not os.path.exists(p)]
+    if missing:
+        print(f"savlint: no such path(s): {', '.join(missing)}", file=sys.stderr)
+        return 2
+
+    try:
+        result = lint_paths(
+            paths,
+            root=root,
+            select=args.select.split(",") if args.select else None,
+            ignore=args.ignore.split(",") if args.ignore else None,
+            # --write-baseline snapshots the UN-baselined findings:
+            # otherwise the old baseline suppresses its own entries out
+            # of the snapshot and the rewrite would drop them.
+            baseline=None
+            if (args.no_baseline or args.write_baseline)
+            else args.baseline,
+        )
+    except (OSError, ValueError) as e:
+        print(f"savlint: {e}", file=sys.stderr)
+        return 2
+
+    if args.write_baseline:
+        try:
+            n = write_baseline(args.baseline, result.findings)
+        except (OSError, ValueError) as e:
+            # Same exit-code contract as the lint itself: a baseline that
+            # cannot be written/parsed is a usage error, not "findings".
+            print(f"savlint: cannot write baseline: {e}", file=sys.stderr)
+            return 2
+        print(
+            f"savlint: wrote {n} baseline entr{'y' if n == 1 else 'ies'} "
+            f"({len(result.findings)} findings) to {args.baseline}; "
+            "edit in justifications before committing"
+        )
+        return 0
+
+    if args.json:
+        print(result.to_json())
+    else:
+        for f in result.findings:
+            print(f.format())
+        summary = (
+            f"savlint: {len(result.findings)} finding"
+            f"{'' if len(result.findings) == 1 else 's'} "
+            f"({len(result.errors)} errors) in {result.files} files; "
+            f"{len(result.suppressed)} suppressed"
+        )
+        print(summary, file=sys.stderr)
+    return 1 if result.findings else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

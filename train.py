@@ -179,6 +179,14 @@ import click
     "checkpoint gap.",
 )
 @click.option(
+    "--sanitize/--no-sanitize", default=False,
+    help="Runtime sanitizers around the steady-state hot loop "
+    "(sav_tpu.analysis.sanitize): disallow implicit host->device "
+    "transfers on the training thread and hard-fail the run if the "
+    "jitted step re-traces after step 1 (silent recompiles are minutes "
+    "each on the relay). Armed after the first completed step.",
+)
+@click.option(
     "--device-preprocess/--no-device-preprocess", default=False,
     help="Ship post-augment uint8 batches (4x fewer host->device bytes "
     "than f32) and run normalize + CutMix/MixUp inside the jitted step "
@@ -217,7 +225,8 @@ def main(
     eval_only, steps, num_train_images,
     num_eval_images, crop_min_area, train_flip, platform, backend_wait,
     fused_optimizer, log_dir, diagnostics, trace_spans, watchdog_secs,
-    device_preprocess, async_feed, feed_depth, compilation_cache_dir, seed,
+    sanitize, device_preprocess, async_feed, feed_depth,
+    compilation_cache_dir, seed,
 ):
     if platform == "cpu":
         # Mirror tests/conftest.py: axon plugin *init* dials the relay even
@@ -317,6 +326,7 @@ def main(
         diagnostics=diagnostics,
         trace_spans=trace_spans,
         watchdog_secs=watchdog_secs,
+        sanitize=sanitize,
         seed=seed,
         **(
             {"num_train_images": num_train_images}
@@ -345,6 +355,7 @@ def main(
             "compilation_cache_dir": "compilation_cache_dir",
             "log_dir": "log_dir", "diagnostics": "diagnostics",
             "trace_spans": "trace_spans", "watchdog_secs": "watchdog_secs",
+            "sanitize": "sanitize",
         }
         overrides = {
             field: getattr(config, field)

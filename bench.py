@@ -35,6 +35,7 @@ from __future__ import annotations
 
 import argparse
 import json
+import os
 import statistics
 import sys
 import time
@@ -120,10 +121,16 @@ def _feed_iterator(feed, batch_size, image_size, tmpdir, device_preprocess=False
 
 
 def run(model_name, batch_size, steps, backend, image_size, reps, feed,
-        device_preprocess=False, async_feed=True, compilation_cache_dir=None):
+        device_preprocess=False, async_feed=True, compilation_cache_dir=None,
+        peak_flops=None):
     import jax
 
     from sav_tpu.data import synthetic_data_iterator
+    from sav_tpu.obs.costs import (
+        publish_cost_gauges,
+        resolve_peak_flops,
+        train_step_cost,
+    )
     from sav_tpu.obs.goodput import GoodputLedger
 
     if compilation_cache_dir:
@@ -152,6 +159,15 @@ def run(model_name, batch_size, steps, backend, image_size, reps, feed,
     state = trainer.init_state()
     rng = jax.random.PRNGKey(0)
     result: dict = {}
+    # Roofline accounting (sav_tpu/obs/costs.py): the synthetic branch
+    # upgrades this analytic estimate with the AOT executable's exact XLA
+    # cost analysis; the fed branches keep the analytic fallback (their
+    # step compiles through the jit dispatch cache).
+    peak, peak_source = resolve_peak_flops(peak_flops)
+    cost = train_step_cost(
+        state.params, batch_size=batch_size, image_size=image_size,
+        n_devices=len(jax.devices()),
+    )
 
     if feed == "synthetic":
         batch = next(
@@ -167,11 +183,12 @@ def run(model_name, batch_size, steps, backend, image_size, reps, feed,
         # One AOT compile: the measurement loop runs the same executable the
         # cost analysis comes from (AOT .compile() does not populate the jit
         # dispatch cache, so mixing AOT + jit would compile twice).
-        from sav_tpu.utils.flops import compiled_flops, per_chip_peak_flops
-
         with ledger.measure("compile"):
             step = trainer._train_step.lower(state, sharded, rng).compile()
-        flops = compiled_flops(step) or None
+        cost = train_step_cost(
+            state.params, batch_size=batch_size, image_size=image_size,
+            compiled=step, n_devices=len(jax.devices()),
+        )
 
         # Warmup. Sync via device_get of the loss value — on relayed/remote
         # platforms block_until_ready alone can return before execution
@@ -190,24 +207,6 @@ def run(model_name, batch_size, steps, backend, image_size, reps, feed,
             elapsed = time.perf_counter() - t0
             ledger.note_window(steps, elapsed, step=(rep + 1) * steps)
             windows.append(elapsed / steps)
-        if flops is not None:
-            # cost_analysis FLOPs are per-device → MFU is per chip.
-            peak = per_chip_peak_flops()
-            if peak:
-                result["mfu"] = round(flops / min(windows) / peak, 4)
-                # The img/s/chip this hardware could do at 100% of its
-                # *theoretical* peak — the physical ceiling of the benchmark
-                # chip. FLOPs are per-device and the batch is sharded, so
-                # the per-chip image share is batch/n_devices. The BASELINE
-                # north star (8,000 img/s/chip) was set for a TPU v4 part;
-                # when this bound is below the north star, no code on this
-                # chip can reach it and vs_baseline must be read against
-                # the bound.
-                per_chip_images = batch_size / len(jax.devices())
-                result["peak_bound_img_per_sec_per_chip"] = round(
-                    peak * per_chip_images / flops, 1
-                )
-            result["step_flops_per_device"] = flops
     else:
         import tempfile
 
@@ -302,6 +301,37 @@ def run(model_name, batch_size, steps, backend, image_size, reps, feed,
 
     n_chips = len(jax.devices())
     best = min(windows)
+    # Cost-model attribution + roofline (docs/perf_accounting.md):
+    # cost_analysis FLOPs are per-device → MFU is per chip. Fed-mode MFU
+    # is end-to-end (the windows interleave host fetch + transfer with
+    # device compute) — lower by construction than the synthetic number.
+    publish_cost_gauges(
+        ledger, cost, peak_flops=peak, peak_source=peak_source
+    )
+    result["step_flops_per_device"] = cost.flops
+    result["cost_source"] = cost.source
+    result["flops_attribution"] = {
+        k: round(v, 4) for k, v in cost.attribution.items()
+    }
+    if cost.flops and peak:
+        ledger.set_gauge("flops_per_s", cost.flops / best)
+        ledger.set_gauge("mfu", cost.flops / best / peak)
+        result["mfu"] = round(cost.flops / best / peak, 4)
+        result["peak_flops_source"] = peak_source
+        if peak_source != "cpu-fake":
+            # The img/s/chip this hardware could do at 100% of its
+            # *theoretical* peak — the physical ceiling of the benchmark
+            # chip. FLOPs are per-device and the batch is sharded, so
+            # the per-chip image share is batch/n_devices. The BASELINE
+            # north star (8,000 img/s/chip) was set for a TPU v4 part;
+            # when this bound is below the north star, no code on this
+            # chip can reach it and vs_baseline must be read against
+            # the bound. Suppressed under the CPU fake peak — a bound
+            # computed from a made-up number would only mislead.
+            per_chip_images = batch_size / n_chips
+            result["peak_bound_img_per_sec_per_chip"] = round(
+                peak * per_chip_images / cost.flops, 1
+            )
     result.update(
         best_step_ms=round(best * 1e3, 2),
         median_img_per_sec_per_chip=round(
@@ -309,7 +339,45 @@ def run(model_name, batch_size, steps, backend, image_size, reps, feed,
         ),
         goodput=ledger.summary(),
     )
+    # Flat metric view for the run manifest (main() pops this before
+    # printing — underscore-prefixed keys never reach the output JSON).
+    result["_manifest_metrics"] = {
+        "value": round(batch_size / best / n_chips, 1),
+        **ledger.flat_metrics(),
+    }
     return batch_size / best / n_chips, n_chips, result
+
+
+def _abort_backend_unreachable(args, manifest, probe_log):
+    """The BENCH_r05 fix: when the relay probe gives up, the run still
+    ends with ONE parseable stdout JSON line — ``outcome:
+    "backend_unreachable"``, the probe timeline, and a pointer to the
+    finalized manifest — instead of prose-only stderr that records as
+    ``"parsed": null``. The stderr message and exit 3 keep the
+    backend_probe abort contract wrapper scripts key on.
+    """
+    from sav_tpu.utils.backend_probe import unreachable_message
+
+    message = unreachable_message("bench", args.backend_wait)
+    probe = {
+        "deadline_s": args.backend_wait,
+        "attempts": len(probe_log),
+        "probes": probe_log,
+    }
+    manifest.finalize(
+        "backend_unreachable", error=message, exit_code=3,
+        notes={"backend_probe": probe},
+    )
+    print(message, file=sys.stderr)
+    print(json.dumps({
+        "metric": f"{args.model} train img/s/chip (bs={args.batch_size})",
+        "value": None,
+        "unit": "img/s/chip",
+        "outcome": "backend_unreachable",
+        "backend_probe": probe,
+        "manifest": manifest.path,
+    }))
+    return 3
 
 
 def main(argv=None):
@@ -361,25 +429,69 @@ def main(argv=None):
         "(0 disables; a transient outage then degrades to a late number "
         "instead of a missing one)",
     )
+    parser.add_argument(
+        "--peak-flops", type=float, default=None,
+        help="per-chip peak FLOP/s override for MFU/roofline accounting "
+        "(docs/perf_accounting.md); default: the device-kind table, with "
+        "a deterministic fake peak on CPU (labeled cpu-fake)",
+    )
+    parser.add_argument(
+        "--manifest", default=None,
+        help="run-manifest path (sav_tpu/obs/manifest.py): written at "
+        "start, finalized with a machine-readable outcome on every exit "
+        "path — including the backend-unreachable abort. Default: a "
+        "per-run runs/bench/manifest-<stamp>-<pid>.json, so successive "
+        "benches accumulate history instead of overwriting one file "
+        "(the sentinel's directory expansion globs manifest*.json)",
+    )
     args = parser.parse_args(argv)
+    if args.manifest is None:
+        args.manifest = os.path.join(
+            "runs", "bench",
+            f"manifest-{time.strftime('%Y%m%d-%H%M%S')}-{os.getpid()}.json",
+        )
     if args.device_preprocess and args.feed == "synthetic":
         parser.error(
             "--device-preprocess measures the fed paths (uint8 transfer + "
             "on-device finishing); the synthetic feed ships device-resident "
             "f32 batches, so the combination would mislabel the metric"
         )
+    from sav_tpu.obs.manifest import RunManifest, classify_exception
+
+    manifest = RunManifest(args.manifest, kind="bench", argv=sys.argv[1:])
+    manifest.begin()
     if args.backend_wait > 0 and "pytest" not in sys.modules:
-        from sav_tpu.utils.backend_probe import require_backend_or_exit
+        from sav_tpu.utils.backend_probe import wait_for_backend
 
-        require_backend_or_exit(args.backend_wait, tag="bench")
+        probe_log: list = []
+        platform = wait_for_backend(
+            args.backend_wait, tag="bench", probe_log=probe_log
+        )
+        if platform is None:
+            return _abort_backend_unreachable(args, manifest, probe_log)
 
-    value, n_chips, extra = run(
-        args.model, args.batch_size, args.steps, args.backend,
-        args.image_size, reps=args.reps, feed=args.feed,
-        device_preprocess=args.device_preprocess,
-        async_feed=not args.no_async_feed,
-        compilation_cache_dir=args.compilation_cache_dir,
-    )
+    try:
+        value, n_chips, extra = run(
+            args.model, args.batch_size, args.steps, args.backend,
+            args.image_size, reps=args.reps, feed=args.feed,
+            device_preprocess=args.device_preprocess,
+            async_feed=not args.no_async_feed,
+            compilation_cache_dir=args.compilation_cache_dir,
+            peak_flops=args.peak_flops,
+        )
+    except BaseException as e:
+        # Every exit path stays parseable: classify (oom/error/...), put
+        # the outcome in the manifest AND on stdout, then re-raise for
+        # the traceback + nonzero rc (the BENCH_r03 failure mode recorded
+        # rc=1 with parsed: null — now the last stdout line explains).
+        outcome = classify_exception(e)
+        manifest.finalize(outcome, error=repr(e), exit_code=1)
+        print(json.dumps({
+            "outcome": outcome,
+            "error": repr(e)[:500],
+            "manifest": manifest.path,
+        }))
+        raise
     feed_desc = args.feed + (
         " uint8+device-preprocess" if args.device_preprocess else ""
     )
@@ -390,6 +502,7 @@ def main(argv=None):
     # stdlib-only module behind lazy package re-exports).
     import jax
 
+    manifest_metrics = extra.pop("_manifest_metrics", {})
     out = {
         "metric": f"{args.model} train img/s/chip (bs={args.batch_size}, "
         f"bf16, {args.backend} attention, {feed_desc} feed, {n_chips} chip, "
@@ -400,8 +513,14 @@ def main(argv=None):
         # Makes a silent CPU fallback visible in the recorded JSON — the
         # number is only comparable to the baseline on a real accelerator.
         "platform": jax.devices()[0].platform,
+        "outcome": "ok",
+        "manifest": manifest.path,
     }
     out.update(extra)
+    manifest.finalize(
+        "ok", exit_code=0, metrics=manifest_metrics,
+        notes={"metric": out["metric"], "platform": out["platform"]},
+    )
     print(json.dumps(out))
     return 0
 

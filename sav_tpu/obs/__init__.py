@@ -17,6 +17,12 @@ Five signals, one design rule each:
 - :mod:`sav_tpu.obs.watchdog` — heartbeat thread that turns a steady-state
   hang (the relay's documented failure mode, ``utils/backend_probe``) into
   a stack dump + labeled exit instead of a job that stalls forever.
+- :mod:`sav_tpu.obs.costs` — FLOPs/bytes cost model (XLA cost-analysis
+  with an analytic per-layer-group fallback) behind the ``goodput/mfu``
+  and per-group attribution gauges.
+- :mod:`sav_tpu.obs.manifest` — structured run manifests finalized with a
+  machine-readable outcome on every exit path, plus the normalized
+  run-record reading shared by the report/sentinel tools.
 
 Re-exports are lazy (PEP 562, same pattern as :mod:`sav_tpu.utils`):
 :mod:`spans`, :mod:`goodput`, and :mod:`watchdog` are stdlib-only and must
@@ -35,12 +41,21 @@ _EXPORTS = {
     "RetraceCounter": "sav_tpu.obs.memory",
     "HangWatchdog": "sav_tpu.obs.watchdog",
     "WATCHDOG_EXIT_CODE": "sav_tpu.obs.watchdog",
+    "StepCost": "sav_tpu.obs.costs",
+    "resolve_peak_flops": "sav_tpu.obs.costs",
+    "train_step_cost": "sav_tpu.obs.costs",
+    "RunManifest": "sav_tpu.obs.manifest",
+    "RunRecord": "sav_tpu.obs.manifest",
+    "classify_exception": "sav_tpu.obs.manifest",
+    "load_run_history": "sav_tpu.obs.manifest",
+    "normalize_run_record": "sav_tpu.obs.manifest",
 }
 
 __all__ = list(_EXPORTS)
 
 _SUBMODULES = frozenset(
-    {"diagnostics", "spans", "goodput", "memory", "watchdog"}
+    {"diagnostics", "spans", "goodput", "memory", "watchdog", "costs",
+     "manifest"}
 )
 
 

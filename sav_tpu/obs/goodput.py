@@ -135,6 +135,13 @@ class GoodputLedger:
     def wall_s(self) -> float:
         return self._clock() - self._t0
 
+    def bucket_seconds(self, bucket: str) -> float:
+        """Accumulated seconds of one bucket (the ``step`` bucket is the
+        end-of-run MFU denominator — obs/costs.py)."""
+        if bucket not in self._buckets:
+            raise KeyError(f"unknown goodput bucket {bucket!r}; use {BUCKETS}")
+        return self._buckets[bucket]
+
     def summary(self) -> dict:
         """End-of-run ledger: buckets (incl. the ``other`` residual) sum to
         ``wall_s`` up to clock-read noise."""

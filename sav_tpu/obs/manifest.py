@@ -1,0 +1,387 @@
+"""Run manifests — one structured JSON record per run, finalized on every
+exit path.
+
+``BENCH_r05.json`` is the motivating failure: a backend-unreachable bench
+recorded ``"rc": 3, "parsed": null`` plus a free-text stderr tail, so no
+tool could tell "infra was down" from "the code regressed". The manifest
+replaces that parse-a-text-tail status quo: ``train.py`` and ``bench.py``
+write a :class:`RunManifest` at start (config, argv, environment
+fingerprint) and finalize it with a machine-readable **outcome** on every
+way out — success, exception, watchdog fire, backend-unreachable abort.
+
+Outcome taxonomy (:data:`OUTCOMES`):
+
+  ok                   — the run completed
+  backend_unreachable  — the startup probe gave up (backend_probe exit 3)
+  retrace              — killed by the retrace sanitizer (steady-state
+                         recompile, sav_tpu.analysis.sanitize)
+  hang                 — the hang watchdog fired (obs.watchdog exit 4)
+  oom                  — device allocator exhaustion
+  error                — any other exception
+  running              — transient: the run is (or died too hard to say)
+
+Design rules: stdlib-only (the backend-unreachable path must run without
+jax — importing it is exactly what hangs); every write is atomic
+(tmp + ``os.replace``) so a watchdog ``os._exit`` mid-write cannot tear
+the file; ``finalize`` is first-wins idempotent and thread-safe, so the
+watchdog thread and a crashing main thread cannot double-report; and a
+failed manifest write never takes the run down (telemetry must not).
+
+The module also owns run-record *reading*: :func:`normalize_run_record` /
+:func:`load_run_history` fold the three shapes history comes in (driver
+``BENCH_r*.json`` wrappers, raw bench JSON lines, manifests) into one
+:class:`RunRecord` view that separates infra failures from measurements —
+shared by ``tools/regression_sentinel.py`` and ``tools/run_report.py``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import platform as _platform
+import socket
+import subprocess
+import sys
+import threading
+import time
+from typing import Any, Optional
+
+OUTCOMES = (
+    "ok", "backend_unreachable", "retrace", "hang", "oom", "error",
+)
+MANIFEST_SCHEMA = 1
+
+
+def classify_exception(exc: BaseException) -> str:
+    """Map an exception to a manifest outcome.
+
+    Matches on type *names* (not imports) so this stays stdlib-only:
+    ``RetraceSanitizerError`` → ``retrace``; allocator exhaustion
+    (``RESOURCE_EXHAUSTED``, "out of memory", ``MemoryError``) → ``oom``;
+    everything else → ``error``.
+    """
+    name = type(exc).__name__
+    if name == "RetraceSanitizerError":
+        return "retrace"
+    text = f"{name}: {exc}".lower()
+    if (
+        "resource_exhausted" in text
+        or "out of memory" in text
+        or isinstance(exc, MemoryError)
+    ):
+        return "oom"
+    return "error"
+
+
+def _git_sha() -> Optional[str]:
+    try:
+        proc = subprocess.run(
+            ["git", "rev-parse", "HEAD"],
+            capture_output=True, text=True, timeout=2.0,
+            cwd=os.path.dirname(os.path.abspath(__file__)),
+        )
+    except Exception:
+        return None
+    sha = proc.stdout.strip()
+    return sha if proc.returncode == 0 and sha else None
+
+
+def environment_fingerprint() -> dict:
+    """Host/toolchain fingerprint, safe to call before (and without) jax.
+
+    Deliberately does NOT import jax and does NOT touch ``jax.devices()``
+    even when jax is already imported — on a wedged relay that is the
+    call that hangs, and the unreachable-backend path is exactly where
+    the fingerprint must still work. Callers that hold live devices add
+    backend facts via :meth:`RunManifest.note`.
+    """
+    env = {
+        "python": sys.version.split()[0],
+        "platform": _platform.platform(),
+        "hostname": socket.gethostname(),
+        "argv0": sys.argv[0] if sys.argv else None,
+        "git_sha": _git_sha(),
+        "jax_platforms": os.environ.get("JAX_PLATFORMS") or None,
+        "accelerator_env": bool(os.environ.get("PALLAS_AXON_POOL_IPS")),
+    }
+    if "jax" in sys.modules:  # version only — never device init
+        env["jax"] = getattr(sys.modules["jax"], "__version__", None)
+    return env
+
+
+class RunManifest:
+    """Lifecycle: ``begin()`` writes an in-progress record; ``note()`` /
+    ``set_metrics()`` accrete facts; ``finalize(outcome)`` stamps the one
+    terminal outcome (first caller wins — later finalizes are ignored, so
+    an exception handler racing the watchdog cannot overwrite ``hang``).
+    """
+
+    def __init__(
+        self,
+        path: str,
+        *,
+        kind: str,
+        argv: Optional[list] = None,
+        config: Optional[dict] = None,
+        clock=time.time,
+    ):
+        self.path = path
+        self._clock = clock
+        self._lock = threading.Lock()
+        self._enabled = True
+        self._data: dict[str, Any] = {
+            "schema": MANIFEST_SCHEMA,
+            "kind": kind,
+            "outcome": "running",
+            "argv": list(argv) if argv is not None else None,
+            "config": config,
+            "env": environment_fingerprint(),
+            "created_unix": round(float(clock()), 3),
+            "finalized_unix": None,
+            "exit_code": None,
+            "error": None,
+            "notes": {},
+            "metrics": {},
+        }
+
+    # ------------------------------------------------------------ lifecycle
+
+    @property
+    def outcome(self) -> str:
+        return self._data["outcome"]
+
+    @property
+    def finalized(self) -> bool:
+        return self._data["outcome"] != "running"
+
+    def begin(self) -> Optional[str]:
+        """Write the in-progress record; returns the path (None if the
+        write failed — telemetry never takes a run down)."""
+        return self._write()
+
+    def disable(self) -> None:
+        """Stop writing (non-zero processes of a multi-host run share the
+        log dir; only process 0 may own the manifest file)."""
+        with self._lock:
+            self._enabled = False
+
+    def set_config(self, config: Optional[dict]) -> None:
+        with self._lock:
+            self._data["config"] = config
+        self._write()
+
+    def note(self, key: str, value: Any) -> None:
+        """Record one machine-readable fact (replication fallback, cost
+        model source, probe timings...). Last write per key wins."""
+        with self._lock:
+            self._data["notes"][key] = value
+        self._write()
+
+    def set_metrics(self, metrics: dict) -> None:
+        """Merge flat scalar metrics (e.g. ``GoodputLedger.flat_metrics``:
+        ``goodput/mfu``, ``goodput/flops/<comp>_frac``, ...)."""
+        with self._lock:
+            for k, v in (metrics or {}).items():
+                self._data["metrics"][k] = v
+        self._write()
+
+    def finalize(
+        self,
+        outcome: str,
+        *,
+        error: Optional[str] = None,
+        exit_code: Optional[int] = None,
+        metrics: Optional[dict] = None,
+        notes: Optional[dict] = None,
+    ) -> bool:
+        """Stamp the terminal outcome; True iff this call won the race."""
+        if outcome not in OUTCOMES:
+            raise ValueError(
+                f"unknown outcome {outcome!r}; use one of {OUTCOMES}"
+            )
+        with self._lock:
+            if self._data["outcome"] != "running":
+                return False
+            self._data["outcome"] = outcome
+            self._data["error"] = error
+            self._data["exit_code"] = exit_code
+            self._data["finalized_unix"] = round(float(self._clock()), 3)
+            for k, v in (metrics or {}).items():
+                self._data["metrics"][k] = v
+            for k, v in (notes or {}).items():
+                self._data["notes"][k] = v
+        self._write()
+        return True
+
+    def move_to(self, path: str) -> None:
+        """Re-home the manifest (config resolution can change the log
+        dir after the early, pre-probe record was written)."""
+        with self._lock:
+            old = self.path
+            self.path = path
+        self._write()
+        if old != path:
+            try:
+                os.remove(old)
+            except OSError:
+                pass
+
+    # ----------------------------------------------------------------- I/O
+
+    def _write(self) -> Optional[str]:
+        with self._lock:
+            if not self._enabled:
+                return None
+            payload = json.dumps(self._data, indent=2, default=str)
+            path = self.path
+        try:
+            parent = os.path.dirname(path)
+            if parent:
+                os.makedirs(parent, exist_ok=True)
+            tmp = f"{path}.tmp.{os.getpid()}"
+            with open(tmp, "w") as f:
+                f.write(payload)
+            os.replace(tmp, path)  # atomic: a crash mid-write cannot tear
+            return path
+        except OSError:
+            return None
+
+    @classmethod
+    def load(cls, path: str) -> dict:
+        with open(path) as f:
+            return json.load(f)
+
+
+# ---------------------------------------------------------- record reading
+
+
+@dataclasses.dataclass
+class RunRecord:
+    """One history entry, normalized: infra failure or measurement."""
+
+    label: str
+    order: float
+    outcome: str
+    metrics: dict[str, float]  # throughput / mfu / input_wait_frac
+    detail: str
+    raw: dict
+
+    @property
+    def ok(self) -> bool:
+        return self.outcome == "ok"
+
+
+def _bench_line_metrics(parsed: dict) -> dict[str, float]:
+    out: dict[str, float] = {}
+    if isinstance(parsed.get("value"), (int, float)):
+        out["throughput"] = float(parsed["value"])
+    if isinstance(parsed.get("mfu"), (int, float)):
+        out["mfu"] = float(parsed["mfu"])
+    goodput = parsed.get("goodput") or {}
+    frac = (goodput.get("fractions") or {}).get("input_wait")
+    if isinstance(frac, (int, float)):
+        out["input_wait_frac"] = float(frac)
+    return out
+
+
+def _manifest_metrics(metrics: dict) -> dict[str, float]:
+    out: dict[str, float] = {}
+    value = metrics.get("value")
+    if isinstance(value, (int, float)):
+        out["throughput"] = float(value)
+    mfu = metrics.get("goodput/mfu", metrics.get("mfu"))
+    if isinstance(mfu, (int, float)):
+        out["mfu"] = float(mfu)
+    wall = metrics.get("goodput/wall_s")
+    wait = metrics.get("goodput/input_wait_s")
+    if (
+        isinstance(wall, (int, float))
+        and isinstance(wait, (int, float))
+        and wall > 0
+    ):
+        out["input_wait_frac"] = float(wait) / float(wall)
+    return out
+
+
+def normalize_run_record(
+    obj: dict, *, label: str = "?", index: int = 0
+) -> RunRecord:
+    """Fold any of the three record shapes into a :class:`RunRecord`.
+
+    Shapes: the driver's ``BENCH_r*.json`` wrapper (``rc``/``parsed``/
+    ``tail``), a raw bench output line (``value``/``unit``), or a
+    manifest (``schema``/``outcome``/``metrics``). Infra failures come
+    back with a non-``ok`` outcome and empty-or-partial metrics — never
+    an exception, so one bad record cannot crash a report over the rest.
+    """
+    order = float(index)
+    if "rc" in obj and "parsed" in obj:  # driver wrapper
+        if isinstance(obj.get("n"), (int, float)):
+            order = float(obj["n"])
+        rc, parsed = obj.get("rc"), obj.get("parsed")
+        if rc == 0 and isinstance(parsed, dict):
+            inner = normalize_run_record(parsed, label=label, index=index)
+            return dataclasses.replace(inner, order=order, raw=obj)
+        tail = (obj.get("tail") or "").lower()
+        if isinstance(parsed, dict) and parsed.get("outcome") in OUTCOMES:
+            outcome = parsed["outcome"]
+        elif "backend unreachable" in tail or rc == 3:
+            outcome = "backend_unreachable"
+        elif rc == 4:
+            outcome = "hang"
+        else:
+            outcome = "error"
+        last = (obj.get("tail") or "").strip().splitlines()
+        return RunRecord(
+            label=label, order=order, outcome=outcome, metrics={},
+            detail=f"rc={rc}" + (f": {last[-1][:100]}" if last else ""),
+            raw=obj,
+        )
+    if obj.get("schema") == MANIFEST_SCHEMA and "outcome" in obj:  # manifest
+        outcome = obj.get("outcome")
+        outcome = outcome if outcome in OUTCOMES else "error"
+        metrics = _manifest_metrics(obj.get("metrics") or {})
+        return RunRecord(
+            label=label, order=order, outcome=outcome, metrics=metrics,
+            detail=obj.get("error") or f"{obj.get('kind', 'run')} manifest",
+            raw=obj,
+        )
+    # Raw bench line.
+    outcome = obj.get("outcome")
+    if outcome not in OUTCOMES:
+        outcome = "ok" if isinstance(obj.get("value"), (int, float)) else "error"
+    metrics = _bench_line_metrics(obj) if outcome == "ok" else {}
+    detail = (
+        f"{obj.get('value')} {obj.get('unit', '')}".strip()
+        if outcome == "ok" else obj.get("error") or outcome
+    )
+    return RunRecord(
+        label=label, order=order, outcome=outcome, metrics=metrics,
+        detail=detail, raw=obj,
+    )
+
+
+def load_run_history(paths: list) -> list[RunRecord]:
+    """Load + normalize + order a list of record files.
+
+    Raises ``OSError``/``ValueError`` for unreadable input (the sentinel
+    maps those to its usage/IO exit code 2 — a torn file is an infra
+    problem to surface, not a regression verdict).
+    """
+    records = []
+    for i, path in enumerate(sorted(paths)):
+        with open(path) as f:
+            try:
+                obj = json.load(f)
+            except json.JSONDecodeError as e:
+                raise ValueError(f"{path}: not valid JSON ({e})") from e
+        if not isinstance(obj, dict):
+            raise ValueError(f"{path}: expected a JSON object")
+        records.append(
+            normalize_run_record(
+                obj, label=os.path.basename(path), index=i
+            )
+        )
+    records.sort(key=lambda r: (r.order, r.label))
+    return records

@@ -57,7 +57,12 @@ class HangWatchdog:
 
     ``ledger``: optional :class:`~sav_tpu.obs.goodput.GoodputLedger`
     whose summary is dumped alongside the stacks (where the time went
-    before the hang). ``exit_fn``/``stream`` are injectable for tests —
+    before the hang). ``manifest``: optional
+    :class:`~sav_tpu.obs.manifest.RunManifest` finalized with
+    ``outcome: "hang"`` *before* the process exits — the hang must be
+    machine-visible in the run record, not only in a stderr dump
+    (``os._exit`` skips every atexit/finally, so nothing downstream gets
+    another chance). ``exit_fn``/``stream`` are injectable for tests —
     production uses ``os._exit`` so a wedged main thread cannot swallow
     the abort.
     """
@@ -67,6 +72,7 @@ class HangWatchdog:
         deadline_s: float,
         *,
         ledger=None,
+        manifest=None,
         tag: str = "watchdog",
         exit_code: int = WATCHDOG_EXIT_CODE,
         exit_fn: Optional[Callable[[int], None]] = None,
@@ -77,6 +83,7 @@ class HangWatchdog:
             raise ValueError(f"deadline_s must be positive, got {deadline_s}")
         self.deadline_s = deadline_s
         self.ledger = ledger
+        self.manifest = manifest
         self.tag = tag
         self.exit_code = exit_code
         self._exit_fn = exit_fn if exit_fn is not None else os._exit
@@ -139,6 +146,26 @@ class HangWatchdog:
                 )
         except Exception as e:  # diagnostics must not mask the abort
             print(f"{self.tag}: dump failed: {e!r}", file=stream)
+        # Finalize the run manifest BEFORE exiting: os._exit skips every
+        # finally/atexit, so this is the record's only chance to say
+        # 'hang' instead of staying 'running'. Own try so a manifest I/O
+        # failure cannot mask the abort either.
+        try:
+            if self.manifest is not None:
+                metrics = None
+                if self.ledger is not None:
+                    metrics = self.ledger.flat_metrics()
+                self.manifest.finalize(
+                    "hang",
+                    error=(
+                        f"{self.tag}: no step completed in "
+                        f"{silent_s:.0f}s (deadline {self.deadline_s:.0f}s)"
+                    ),
+                    exit_code=self.exit_code,
+                    metrics=metrics,
+                )
+        except Exception as e:
+            print(f"{self.tag}: manifest finalize failed: {e!r}", file=stream)
         stream.flush()
         self.fired.set()
         self._exit_fn(self.exit_code)

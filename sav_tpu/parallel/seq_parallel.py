@@ -40,6 +40,55 @@ from sav_tpu.parallel.ulysses import _ulysses_shard_fn
 
 METHODS = ("ring", "ulysses")
 
+# ---------------------------------------------------------------------------
+# Batch-replication fallback observability. Replicating the batch across
+# the sequence group is *correct* but multiplies per-device attention
+# memory/compute by the data-axis product — a silent footgun at training
+# scale, so degraded-parallelism runs must be machine-visible. Listeners
+# (Trainer.fit registers one per fit: once-per-fit warning +
+# SpanTracer.instant + manifest note) take precedence; without any, the
+# module warns once per (batch, group) shape per process instead of
+# per trace.
+
+_replication_listeners: list = []
+_replication_warned: set = set()
+
+
+def on_batch_replication(callback):
+    """Register ``callback(info_dict)`` for replication-fallback events;
+    returns a zero-arg unsubscribe. Listener exceptions are swallowed —
+    observability must never fail a trace."""
+    _replication_listeners.append(callback)
+
+    def unsubscribe():
+        try:
+            _replication_listeners.remove(callback)
+        except ValueError:
+            pass
+
+    return unsubscribe
+
+
+def _replication_fallback(b: int, group: int) -> None:
+    info = {"batch": int(b), "data_axis_product": int(group)}
+    handled = False
+    for callback in list(_replication_listeners):
+        try:
+            callback(dict(info))
+            handled = True
+        except Exception:
+            pass
+    key = (int(b), int(group))
+    if not handled and key not in _replication_warned:
+        _replication_warned.add(key)
+        warnings.warn(
+            f"sequence_parallel_attention: batch {b} does not divide the "
+            f"mesh's data-axis product {group}; replicating the batch "
+            "across all sequence-group members. Size the global batch as "
+            "a multiple of the data axes for training-scale calls.",
+            stacklevel=3,
+        )
+
 
 def sequence_parallel_attention(
     query: jax.Array,
@@ -102,18 +151,10 @@ def sequence_parallel_attention(
         # single-example debugging want).
         batch_axis = axes if axes and b % group == 0 else None
         if batch_axis is None and axes and group > 1:
-            # Replication is correct but multiplies per-device attention
-            # memory/compute by the data-axis product — fine for debugging,
-            # a silent footgun at training scale. Fires at trace time only;
-            # warnings' default filter dedups repeats of the same (b, group)
-            # message, so steady-state training logs one line per shape.
-            warnings.warn(
-                f"sequence_parallel_attention: batch {b} does not divide the "
-                f"mesh's data-axis product {group}; replicating the batch "
-                "across all sequence-group members. Size the global batch as "
-                "a multiple of the data axes for training-scale calls.",
-                stacklevel=2,
-            )
+            # Fine for debugging, a footgun at training scale: route the
+            # event through the observability hook above (listeners or a
+            # once-per-shape process warning). Fires at trace time only.
+            _replication_fallback(b, group)
     if method == "ulysses" and heads % n:
         raise ValueError(
             f"ulysses needs head count ({heads}) divisible by the "

@@ -152,6 +152,12 @@ class TrainConfig:
     # no step completes within this many seconds (None disables). Armed
     # after the first step so compile time cannot false-fire it.
     watchdog_secs: Optional[float] = None
+    # Per-chip peak FLOP/s override for MFU/roofline accounting
+    # (sav_tpu/obs/costs.py; train.py --peak-flops). None = resolve from
+    # the device-kind table; unknown accelerators then report no MFU, and
+    # CPU resolves to a deterministic fake peak (labeled 'cpu-fake') so
+    # the attribution/MFU plumbing stays assertable in tier-1.
+    peak_flops: Optional[float] = None
     # Runtime sanitizers (sav_tpu.analysis.sanitize;
     # docs/static_analysis.md): after the first completed step, arm
     # jax.transfer_guard_host_to_device("disallow") on the training

@@ -19,6 +19,7 @@ from __future__ import annotations
 import json
 import os
 import time
+import warnings
 from collections import deque
 from typing import Any, Iterator, Optional
 
@@ -47,6 +48,21 @@ from sav_tpu.train.state import TrainState
 from sav_tpu.utils import profiler
 from sav_tpu.utils.debug import assert_all_finite
 from sav_tpu.utils.metrics import cross_entropy, topk_correct
+
+
+def _cost_note(cost, peak_flops, peak_source) -> dict:
+    """Manifest note for the step cost model (obs/costs.py) — the
+    machine-readable twin of the goodput flops/* gauges."""
+    return {
+        "source": cost.source,
+        "flops_per_device": cost.flops,
+        "bytes_accessed": cost.bytes_accessed,
+        "attribution": cost.attribution,
+        "groups": cost.groups,
+        "num_tokens": cost.num_tokens,
+        "peak_flops": peak_flops,
+        "peak_flops_source": peak_source,
+    }
 
 
 class Trainer:
@@ -655,11 +671,13 @@ class Trainer:
 
     # ------------------------------------------------------------------ loop
 
-    @property
-    def _peak_flops(self) -> Optional[float]:
-        from sav_tpu.utils.flops import per_chip_peak_flops
+    def _resolve_peak(self) -> tuple[Optional[float], str]:
+        """(per-chip peak FLOP/s, source) for MFU accounting — the
+        config override, the device table, or CPU's deterministic fake
+        (sav_tpu/obs/costs.py)."""
+        from sav_tpu.obs.costs import resolve_peak_flops
 
-        return per_chip_peak_flops()
+        return resolve_peak_flops(self.config.peak_flops)
 
     def train_step(self, state: TrainState, batch: dict, rng: jax.Array):
         return self._train_step(state, self.shard_batch(batch), rng)
@@ -782,6 +800,7 @@ class Trainer:
         eval_iter_fn=None,
         state: Optional[TrainState] = None,
         log_fn=None,
+        manifest=None,
     ) -> tuple[TrainState, list[dict]]:
         """Run the training loop.
 
@@ -793,6 +812,12 @@ class Trainer:
             (fixes the reference's exhausted-generator eval bug,
             train.py:239-250 / SURVEY.md §2.9 #21).
           log_fn: callable(dict) for metrics (host-side, outside jit).
+          manifest: optional :class:`~sav_tpu.obs.manifest.RunManifest`.
+            fit() accretes facts onto it (backend, cost model, goodput
+            metrics — on crash paths too, via the finally below) and hands
+            it to the hang watchdog (which finalizes ``outcome: "hang"``
+            before exit 4); the *caller* owns terminal ok/error
+            finalization, since a run may continue past fit().
 
         Input feed (docs/input_pipeline.md): with ``config.async_feed``
         (the default) batches are fetched and placed on device by a
@@ -850,15 +875,87 @@ class Trainer:
             # counts one beat at its end, so size watchdog_secs above the
             # slowest of those, not just above the step time.
             watchdog = HangWatchdog(
-                cfg.watchdog_secs, ledger=ledger, tag="train-watchdog"
+                cfg.watchdog_secs, ledger=ledger, tag="train-watchdog",
+                manifest=manifest,
             )
-        # When MFU can be reported (known chip peak), the step is compiled
-        # ahead-of-time ONCE and the loop calls the compiled executable —
-        # cost analysis comes from the same compilation, not a second one
-        # (AOT .compile() does not populate the jit dispatch cache).
-        step_flops: Optional[float] = None
+        # Cost model (sav_tpu/obs/costs.py): an analytic per-layer-group
+        # FLOPs estimate exists up front on any backend; the total is
+        # upgraded to XLA's exact cost-analysis count when the AOT path
+        # compiles. Attribution gauges publish immediately so even a
+        # crashed run's manifest says where the FLOPs were going.
+        from sav_tpu.obs.costs import (
+            publish_cost_gauges,
+            publish_mfu_gauges,
+            train_step_cost,
+        )
+
+        peak_flops, peak_source = self._resolve_peak()
+        cost = train_step_cost(
+            state.params,
+            batch_size=cfg.global_batch_size,
+            image_size=cfg.image_size,
+            n_devices=len(jax.devices()),
+        )
+        step_flops: Optional[float] = cost.flops or None
+        publish_cost_gauges(
+            ledger, cost, peak_flops=peak_flops, peak_source=peak_source
+        )
+        if manifest is not None:
+            device0 = jax.devices()[0]
+            manifest.note("backend", {
+                "platform": device0.platform,
+                "device_kind": getattr(device0, "device_kind", None),
+                "n_devices": len(jax.devices()),
+                "process_count": jax.process_count(),
+            })
+            manifest.note(
+                "cost_model", _cost_note(cost, peak_flops, peak_source)
+            )
+        # The step is compiled ahead-of-time ONCE (and the loop calls the
+        # compiled executable — cost analysis comes from the same
+        # compilation, not a second one; AOT .compile() does not populate
+        # the jit dispatch cache) only when the peak is a real hardware
+        # number: under the CPU fake peak the loop keeps the plain jit
+        # dispatch path, whose retrace behavior the sanitizer/diagnostics
+        # contracts (and their tests) rely on.
+        use_aot = bool(peak_flops) and peak_source in (
+            "device-table", "override"
+        )
         compiled_step = None
-        peak_flops = self._peak_flops
+        # Sequence-parallel batch-replication fallback: surface the
+        # trace-time event ONCE per fit — a warning, a span-trace instant,
+        # a ledger gauge, and a manifest note — instead of a per-call
+        # UserWarning (degraded parallelism must be machine-visible, not
+        # log spam).
+        unsub_replication = None
+        if cfg.sequence_parallel:
+            from sav_tpu.parallel import seq_parallel as _seq_parallel
+
+            _replication_seen: list = []
+
+            def _on_replication(info):
+                if _replication_seen:
+                    return
+                _replication_seen.append(info)
+                warnings.warn(
+                    "sequence-parallel batch-replication fallback: batch "
+                    f"{info['batch']} does not divide the mesh's data-axis "
+                    f"product {info['data_axis_product']}; attention "
+                    "memory/compute is multiplied by that product for the "
+                    "whole fit (reported once; see manifest "
+                    "notes.seq_replication_fallback)",
+                    stacklevel=2,
+                )
+                tracer.instant("seq_replication_fallback", **info)
+                # set_gauge coerces to float itself (info is a plain host
+                # dict — no device value anywhere near this path).
+                ledger.set_gauge("seq/replicated_batch", info["batch"])
+                if manifest is not None:
+                    manifest.note("seq_replication_fallback", info)
+
+            unsub_replication = _seq_parallel.on_batch_replication(
+                _on_replication
+            )
         start_step = int(jax.device_get(state.step))  # savlint: disable=SAV101 -- one-time read before the loop, not per-step
         t_last = time.time()
         last_logged_step = start_step
@@ -929,14 +1026,34 @@ class Trainer:
                     with tracer.span("shard_batch", step=step + 1), \
                             ledger.measure("h2d"):
                         sharded = self.shard_batch(batch)  # savlint: disable=SAV106 -- the sanctioned serial fallback (async_feed=False)
-                if peak_flops and compiled_step is None:
+                if use_aot and compiled_step is None:
                     from sav_tpu.utils.flops import compiled_flops
 
                     with tracer.span("compile"), ledger.measure("compile"):
                         compiled_step = self._train_step.lower(
                             state, sharded, rng
                         ).compile()
-                        step_flops = compiled_flops(compiled_step)
+                        aot_flops = compiled_flops(compiled_step)
+                    if aot_flops:
+                        # Upgrade the analytic total to XLA's exact
+                        # per-device count; attribution fractions stay
+                        # analytic (the XLA total does not decompose).
+                        import dataclasses as _dc
+
+                        step_flops = aot_flops
+                        cost = _dc.replace(
+                            cost, flops=aot_flops,
+                            source="xla-cost-analysis",
+                        )
+                        publish_cost_gauges(
+                            ledger, cost, peak_flops=peak_flops,
+                            peak_source=peak_source,
+                        )
+                        if manifest is not None:
+                            manifest.note(
+                                "cost_model",
+                                _cost_note(cost, peak_flops, peak_source),
+                            )
                     # Don't let compile time pollute the first throughput
                     # and MFU window.
                     t_last = time.time()
@@ -1092,8 +1209,23 @@ class Trainer:
                 # Thread-local config context: must unwind on this (the
                 # entering) thread before fit returns.
                 sanitizer.close()
+            if unsub_replication is not None:
+                unsub_replication()
             if profiling:
                 profiler.stop_trace()
+            # End-of-run roofline gauges (goodput/mfu, goodput/flops_per_s)
+            # from the ledger's own aggregates — no device sync involved.
+            # In the finally so crashed runs report too, and the manifest
+            # carries whatever telemetry exists at the point of death.
+            publish_mfu_gauges(
+                ledger,
+                step_flops=step_flops or 0.0,
+                peak_flops=peak_flops,
+                steps=ledger.steps,
+                step_seconds=ledger.bucket_seconds("step"),
+            )
+            if manifest is not None:
+                manifest.set_metrics(ledger.flat_metrics())
             tracer.write()
         self.last_goodput = ledger.summary()
         if obs_dir is not None and obs_writer:

@@ -76,8 +76,19 @@ def probe_backend(timeout_s: float):
     return platform
 
 
+def unreachable_message(tag: str, deadline_s: float) -> str:
+    """The one abort line wrapper scripts grep for — single definition so
+    bench.py (which layers a parseable stdout JSON record on top) and
+    train.py cannot drift from each other."""
+    return (
+        f"{tag}: accelerator backend unreachable within "
+        f"--backend-wait={deadline_s:.0f}s; aborting"
+    )
+
+
 def wait_for_backend(deadline_s: float = 600.0, poll_s: float = 30.0,
-                     probe_s: float = 90.0, tag: str = "backend-probe"):
+                     probe_s: float = 90.0, tag: str = "backend-probe",
+                     probe_log: Optional[list] = None):
     """Poll the accelerator relay until it answers or the deadline passes.
 
     Returns the platform string, or None when the deadline expired (the
@@ -89,8 +100,17 @@ def wait_for_backend(deadline_s: float = 600.0, poll_s: float = 30.0,
     only gives up once ~1 s of budget remains — the last probe runs with
     whatever is left rather than abandoning up to ``poll_s`` unused
     (ADVICE.md r5). Logs to stderr under ``tag``.
+
+    ``probe_log``: optional list the wait appends one dict per probe to
+    (``attempt``/``elapsed_s``/``platform``) — the machine-readable probe
+    timeline run manifests and bench.py's backend-unreachable JSON record
+    carry instead of re-parsing the stderr prose.
     """
     if not accelerator_expected():
+        if probe_log is not None:
+            probe_log.append(
+                {"attempt": 0, "elapsed_s": 0.0, "platform": "cpu"}
+            )
         return "cpu"
     t0 = time.monotonic()
     attempt = 0
@@ -98,6 +118,12 @@ def wait_for_backend(deadline_s: float = 600.0, poll_s: float = 30.0,
         attempt += 1
         remaining = deadline_s - (time.monotonic() - t0)
         platform = probe_backend(timeout_s=min(probe_s, max(remaining, 1.0)))
+        if probe_log is not None:
+            probe_log.append({
+                "attempt": attempt,
+                "elapsed_s": round(time.monotonic() - t0, 1),
+                "platform": platform,
+            })
         if platform is not None:
             if attempt > 1:
                 print(
@@ -126,22 +152,41 @@ def wait_for_backend(deadline_s: float = 600.0, poll_s: float = 30.0,
         time.sleep(sleep_s)
 
 
-def require_backend_or_exit(deadline_s: float, tag: str, exit_code: int = 3):
+def require_backend_or_exit(deadline_s: float, tag: str, exit_code: int = 3,
+                            manifest=None):
     """``wait_for_backend`` or abort the process with ``exit_code``.
 
     Single definition of the abort contract (message format + exit 3) that
-    wrapper scripts key on; used by both ``bench.py`` and ``train.py`` so
-    the two CLIs cannot drift. Returns the platform string on success.
+    wrapper scripts key on; used by ``train.py`` directly and mirrored by
+    ``bench.py`` (which adds a parseable stdout JSON record on top of the
+    same :func:`unreachable_message`). Returns the platform string on
+    success.
+
+    ``manifest``: optional :class:`~sav_tpu.obs.manifest.RunManifest`
+    finalized with ``outcome: "backend_unreachable"`` + the probe timeline
+    before the abort, so the run record never degrades to prose-only
+    (the BENCH_r05 failure mode).
     """
-    platform = wait_for_backend(deadline_s=deadline_s, tag=tag)
+    probe_log: list = []
+    platform = wait_for_backend(
+        deadline_s=deadline_s, tag=tag, probe_log=probe_log
+    )
     if platform is None:
         # Proceeding would hang in in-process backend init (the wedged
         # relay fails by hanging, not erroring); a prompt labeled exit
         # beats a job that stalls forever holding its slot.
-        print(
-            f"{tag}: accelerator backend unreachable within "
-            f"--backend-wait={deadline_s:.0f}s; aborting",
-            file=sys.stderr,
-        )
+        message = unreachable_message(tag, deadline_s)
+        if manifest is not None:
+            manifest.finalize(
+                "backend_unreachable",
+                error=message,
+                exit_code=exit_code,
+                notes={"backend_probe": {
+                    "deadline_s": deadline_s,
+                    "attempts": len(probe_log),
+                    "probes": probe_log,
+                }},
+            )
+        print(message, file=sys.stderr)
         raise SystemExit(exit_code)
     return platform

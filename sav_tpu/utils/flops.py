@@ -36,10 +36,25 @@ def per_chip_peak_flops(devices=None) -> Optional[float]:
     return None
 
 
+def xla_cost_analysis(compiled) -> dict:
+    """``compiled.cost_analysis()`` normalized to one dict ({} if absent).
+
+    Backends disagree on shape: TPU returns a dict, CPU a one-element
+    list of dicts — normalize so callers (``compiled_flops``,
+    ``obs/costs.py``) read ``'flops'``/``'bytes accessed'`` uniformly.
+    """
+    try:
+        cost = compiled.cost_analysis()
+    except Exception:  # pragma: no cover - backend-dependent
+        return {}
+    if isinstance(cost, (list, tuple)):
+        cost = cost[0] if cost else {}
+    return dict(cost) if cost else {}
+
+
 def compiled_flops(compiled) -> float:
     """Per-device FLOPs from a compiled executable (0.0 if unavailable)."""
     try:
-        cost = compiled.cost_analysis()
-        return float(cost.get("flops", 0.0)) if cost else 0.0
+        return float(xla_cost_analysis(compiled).get("flops", 0.0) or 0.0)
     except Exception:  # pragma: no cover - backend-dependent
         return 0.0

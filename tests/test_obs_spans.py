@@ -78,6 +78,37 @@ def test_span_records_on_exception(tmp_path):
     assert "failing" in names
 
 
+def test_write_is_idempotent_after_midrun_exception(tmp_path):
+    """Crash-path contract (ISSUE 4): a loop that flushes periodically and
+    then dies mid-run leaves a valid, loadable Chrome trace — and a later
+    flush (e.g. from an exception handler) is safe and wins."""
+    path = str(tmp_path / "t.json")
+    tracer = SpanTracer(path)
+    with tracer.span("step_dispatch", step=1):
+        pass
+    assert tracer.write() == path  # periodic flush mid-run
+    with open(path) as f:
+        first = json.load(f)["traceEvents"]
+    try:
+        with tracer.span("step_dispatch", step=2):
+            raise RuntimeError("mid-run crash")
+    except RuntimeError:
+        pass
+    # Second write after the exception: still valid JSON, strictly more
+    # events (the crashed span was recorded by the context manager), and
+    # repeatable.
+    assert tracer.write() == path
+    assert tracer.write() == path
+    with open(path) as f:
+        doc = json.load(f)
+    events = [e for e in doc["traceEvents"] if e.get("ph") == "X"]
+    assert len(events) > len([e for e in first if e.get("ph") == "X"])
+    steps = {e.get("args", {}).get("step") for e in events}
+    assert {1, 2} <= steps
+    for e in events:
+        assert set(e) >= {"name", "ph", "ts", "dur", "pid", "tid"}
+
+
 def test_concurrent_spans_are_thread_safe(tmp_path):
     path = str(tmp_path / "t.json")
     tracer = SpanTracer(path)

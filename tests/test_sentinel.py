@@ -1,0 +1,197 @@
+"""Regression sentinel (ISSUE 4): the tier-1 smoke over the checked-in
+fixture histories pins the CI exit-code contract — 0 on a clean history,
+1 on the planted throughput/MFU regression, 0 when the only deltas are
+infra failures, 2 on usage/IO errors — plus unit coverage of the
+median+MAD math and the record normalization it stands on."""
+
+import importlib.util
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+from sav_tpu.obs.manifest import load_run_history, normalize_run_record
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+FIXTURES = os.path.join(os.path.dirname(__file__), "sentinel_fixtures")
+SENTINEL = os.path.join(ROOT, "tools", "regression_sentinel.py")
+
+
+def _load_sentinel():
+    spec = importlib.util.spec_from_file_location("regression_sentinel", SENTINEL)
+    module = importlib.util.module_from_spec(spec)
+    # Registered BEFORE exec: dataclasses resolves the module's postponed
+    # annotations through sys.modules.
+    sys.modules[spec.name] = module
+    spec.loader.exec_module(module)
+    return module
+
+
+sentinel = _load_sentinel()
+
+
+def _run_cli(*args):
+    return subprocess.run(
+        [sys.executable, SENTINEL, *args],
+        capture_output=True, text=True, cwd=ROOT,
+    )
+
+
+# ------------------------------------------------------ exit-code contract
+
+
+def test_clean_history_exits_zero():
+    proc = _run_cli(os.path.join(FIXTURES, "clean"))
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "REGRESS" not in proc.stdout
+
+
+def test_planted_regression_exits_one_and_names_the_metrics():
+    proc = _run_cli(os.path.join(FIXTURES, "regressed"))
+    assert proc.returncode == 1, proc.stdout + proc.stderr
+    # The planted drop hits throughput AND mfu; input_wait stays clean.
+    assert "REGRESS throughput" in proc.stdout
+    assert "REGRESS mfu" in proc.stdout
+    assert "REGRESS input_wait_frac" not in proc.stdout
+
+
+def test_infra_failures_only_exits_zero_but_lists_them():
+    """The BENCH_r05 lesson: a down relay is not a regression. Records
+    with rc != 0 / parsed: null are reported, never scored."""
+    proc = _run_cli(os.path.join(FIXTURES, "infra_only"))
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "2 infra failures" in proc.stdout
+    assert "backend_unreachable" in proc.stdout
+    assert "REGRESS" not in proc.stdout
+
+
+def test_usage_and_io_errors_exit_two(tmp_path):
+    assert _run_cli().returncode == 2  # no inputs
+    assert _run_cli("/no/such/file.json").returncode == 2
+    assert _run_cli("--metric", "nope", os.path.join(FIXTURES, "clean")
+                    ).returncode == 2
+    torn = tmp_path / "BENCH_torn.json"
+    torn.write_text('{"rc": 0, "parsed"')  # torn tail of a crashed write
+    assert _run_cli(str(torn)).returncode == 2
+
+
+def test_json_report_is_machine_readable():
+    proc = _run_cli("--json", os.path.join(FIXTURES, "regressed"))
+    assert proc.returncode == 1
+    payload = json.loads(proc.stdout)
+    assert payload["regressed"] is True
+    regressed = {v["metric"] for v in payload["verdicts"] if v["regressed"]}
+    assert regressed == {"throughput", "mfu"}
+
+
+# --------------------------------------------------------- detection math
+
+
+def test_mad_threshold_adapts_to_series_noise():
+    noisy = [100.0, 120.0, 80.0, 110.0, 90.0]
+    quiet = [100.0, 100.5, 99.5, 100.2, 99.8]
+    _, _, t_noisy = sentinel.robust_threshold(noisy, k=3.5, rel_floor=0.0)
+    _, _, t_quiet = sentinel.robust_threshold(quiet, k=3.5, rel_floor=0.0)
+    assert t_noisy > t_quiet > 0
+
+
+def test_rel_floor_prevents_zero_variance_flagging():
+    flat = [100.0] * 5
+    _, mad, threshold = sentinel.robust_threshold(flat, k=3.5, rel_floor=0.05)
+    assert mad == 0.0
+    assert threshold == pytest.approx(5.0)  # 5% of the median, not zero
+
+
+def test_zero_median_fraction_baseline_does_not_flag_jitter():
+    """A perfectly-overlapped history records input_wait_frac 0.0 (the
+    ledger rounds fractions to 4 decimals); the relative floor is inert at
+    median 0, so the absolute floor must absorb sub-point jitter."""
+    def rec(wait_frac):
+        return normalize_run_record({
+            "value": 1000.0, "unit": "img/s/chip",
+            "goodput": {"fractions": {"input_wait": wait_frac}},
+        })
+
+    records = [rec(0.0), rec(0.0), rec(0.0), rec(0.0002)]
+    verdict = sentinel.judge_metric(
+        records, "input_wait_frac", k=3.5, rel_floor=0.05, min_history=2
+    )
+    assert verdict is not None and not verdict.regressed
+    # A real input-side regression (5% of wall blocked) still flags.
+    bad = sentinel.judge_metric(
+        records[:3] + [rec(0.05)], "input_wait_frac", k=3.5,
+        rel_floor=0.05, min_history=2,
+    )
+    assert bad.regressed
+
+
+def test_min_history_below_one_is_a_usage_error():
+    proc = _run_cli(
+        "--min-history", "0", os.path.join(FIXTURES, "clean")
+    )
+    assert proc.returncode == 2
+    assert "min-history" in proc.stderr
+
+
+def test_judge_metric_directionality():
+    def rec(value, ok=True):
+        return normalize_run_record(
+            {"value": value, "unit": "img/s/chip",
+             "goodput": {"fractions": {"input_wait": value / 1e4}}},
+        )
+
+    stable = [rec(1000.0), rec(1010.0), rec(990.0)]
+    # Higher-is-better: a drop flags, a rise does not.
+    drop = sentinel.judge_metric(
+        stable + [rec(500.0)], "throughput", k=3.5, rel_floor=0.05,
+        min_history=2,
+    )
+    rise = sentinel.judge_metric(
+        stable + [rec(1500.0)], "throughput", k=3.5, rel_floor=0.05,
+        min_history=2,
+    )
+    assert drop.regressed and not rise.regressed
+    # Lower-is-better (input_wait_frac): the same records' rising wait flags.
+    wait = sentinel.judge_metric(
+        stable + [rec(1500.0)], "input_wait_frac", k=3.5, rel_floor=0.05,
+        min_history=2,
+    )
+    assert wait.regressed
+
+
+def test_insufficient_history_is_not_scored():
+    records = [
+        normalize_run_record({"value": 100.0, "unit": "img/s/chip"}),
+        normalize_run_record({"value": 10.0, "unit": "img/s/chip"}),
+    ]
+    assert sentinel.judge_metric(
+        records, "throughput", k=3.5, rel_floor=0.05, min_history=2
+    ) is None
+
+
+# ----------------------------------------------------- record normalization
+
+
+def test_history_orders_by_wrapper_n_not_filename(tmp_path):
+    # Filename order disagrees with the run order: 'a.json' is run 9.
+    (tmp_path / "a.json").write_text(json.dumps(
+        {"n": 9, "rc": 0, "tail": "", "parsed": {"value": 5.0, "unit": "x"}}
+    ))
+    (tmp_path / "b.json").write_text(json.dumps(
+        {"n": 1, "rc": 0, "tail": "", "parsed": {"value": 100.0, "unit": "x"}}
+    ))
+    records = load_run_history([str(tmp_path / "a.json"), str(tmp_path / "b.json")])
+    assert [r.metrics["throughput"] for r in records] == [100.0, 5.0]
+
+
+def test_real_bench_history_loads_and_separates_infra():
+    paths = [
+        os.path.join(ROOT, f"BENCH_r0{i}.json") for i in range(1, 6)
+    ]
+    records = load_run_history(paths)
+    outcomes = [r.outcome for r in records]
+    assert outcomes[:2] == ["ok", "ok"]
+    assert "backend_unreachable" in outcomes  # r04/r05's rc=3 probe abort
+    assert all(not r.ok for r in records[2:])

@@ -277,3 +277,125 @@ def test_trainer_sp_requires_seq_axis(devices):
     )
     with pytest.raises(ValueError, match="'seq' mesh axis"):
         Trainer(config)
+
+
+# ------------------------------------------------- replication observability
+
+
+def test_replication_fallback_notifies_listeners(devices):
+    """ISSUE 4 satellite: the batch-replication fallback routes through
+    the observability hook — a registered listener (what Trainer.fit
+    installs) receives the machine-readable event at trace time."""
+    from sav_tpu.parallel import seq_parallel as sp
+
+    mesh = create_mesh({"data": 4, "seq": 2})
+    events = []
+    unsubscribe = sp.on_batch_replication(events.append)
+    try:
+        q, k, v = _qkv(b=2, l=16)  # batch 2 does not divide data product 4
+        sequence_parallel_attention(q, k, v, mesh=mesh, method="ring")
+    finally:
+        unsubscribe()
+    assert events and events[0] == {"batch": 2, "data_axis_product": 4}
+    # After unsubscribe the hook no longer reaches the listener.
+    before = len(events)
+    q, k, v = _qkv(b=2, l=16, seed=1)
+    sequence_parallel_attention(q, k, v, mesh=mesh, method="ring")
+    assert len(events) == before
+
+
+def test_replication_warning_fires_once_per_shape_without_listeners():
+    """Without listeners the module warns once per (batch, group) shape
+    per process — not per call (the old per-trace UserWarning spam)."""
+    import warnings
+
+    from sav_tpu.parallel import seq_parallel as sp
+
+    key = (313, 757)  # synthetic shape no other test uses
+    sp._replication_warned.discard(key)
+    with warnings.catch_warnings(record=True) as caught:
+        warnings.simplefilter("always")
+        sp._replication_fallback(*key)
+        sp._replication_fallback(*key)
+    assert len(caught) == 1
+    assert "replicating the batch" in str(caught[0].message)
+
+
+def test_replication_listener_exceptions_are_swallowed():
+    import warnings
+
+    from sav_tpu.parallel import seq_parallel as sp
+
+    def bad_listener(info):
+        raise RuntimeError("observer crash")
+
+    unsubscribe = sp.on_batch_replication(bad_listener)
+    try:
+        with warnings.catch_warnings():
+            # A crashed listener counts as unhandled, so the module falls
+            # back to its own (expected) warning — not the test's concern.
+            warnings.simplefilter("ignore")
+            sp._replication_fallback(311, 751)  # must not raise
+    finally:
+        unsubscribe()
+
+
+def test_fit_records_replication_fallback_once(devices, tmp_path):
+    """Trainer integration: a degraded-parallelism fit warns ONCE, marks
+    the span trace, sets the ledger gauge, and notes the manifest. The
+    trigger is the realistic one — grad accumulation shrinks the
+    micro-batch (4/2 = 2) below the 4-way data-axis product while the
+    global batch still places cleanly."""
+    import json as _json
+    import warnings
+
+    from sav_tpu.obs.manifest import RunManifest
+
+    config = TrainConfig(
+        model_name="vit_ti_patch16",
+        num_classes=10,
+        image_size=32,
+        compute_dtype="float32",
+        global_batch_size=4,
+        grad_accum_steps=2,  # micro-batch 2 does not divide data axis 4
+        num_train_images=8,
+        num_epochs=1,
+        warmup_epochs=1,
+        lr_scaling_divisor=4,
+        transpose_images=False,
+        log_every_steps=2,
+        log_dir=str(tmp_path),
+        trace_spans=True,
+        mesh_axes={"data": 4, "seq": 2},
+        sequence_parallel="ring",
+        model_overrides=dict(num_layers=1, embed_dim=64, num_heads=4),
+        seed=0,
+    )
+    trainer = Trainer(config)
+    manifest = RunManifest(str(tmp_path / "manifest.json"), kind="train")
+    manifest.begin()
+    rng = np.random.default_rng(0)
+
+    def batches(n):
+        for _ in range(n):
+            yield {
+                "images": rng.normal(size=(4, 32, 32, 3)).astype(np.float32),
+                "labels": (np.arange(4) % 10).astype(np.int32),
+            }
+
+    with warnings.catch_warnings(record=True) as caught:
+        warnings.simplefilter("always")
+        trainer.fit(batches(2), num_steps=2, manifest=manifest)
+    fit_warnings = [
+        w for w in caught
+        if "batch-replication fallback" in str(w.message)
+    ]
+    assert len(fit_warnings) == 1  # once per fit, not per call/trace
+    doc = RunManifest.load(manifest.path)
+    assert doc["notes"]["seq_replication_fallback"] == {
+        "batch": 2, "data_axis_product": 4,  # the micro-batch, not global
+    }
+    assert trainer.last_goodput["gauges"]["seq/replicated_batch"] == 2.0
+    with open(tmp_path / "spans.trace.json") as f:
+        names = {e["name"] for e in _json.load(f)["traceEvents"]}
+    assert "seq_replication_fallback" in names

@@ -1,0 +1,251 @@
+#!/usr/bin/env python
+"""Perf regression sentinel over the run history (CI gate).
+
+Ingests the bench/manifest record history — driver ``BENCH_r*.json``
+wrappers, raw ``bench.py`` JSON lines, and ``RunManifest`` files
+(``sav_tpu/obs/manifest.py`` normalizes all three) — separates **infra
+failures** (``rc != 0``, ``parsed: null``, ``outcome:
+backend_unreachable/hang/...``) from **measurements**, and flags
+regressions in the latest measurement against the robust statistics of
+the prior ones.
+
+Detection is median + MAD (median absolute deviation), the standard
+robust outlier test: for each tracked metric the newest measurement is a
+regression when it falls on the wrong side of
+``median ± max(K * 1.4826 * MAD, rel_floor * |median|)`` — the MAD term
+adapts to the series' own noise (the relayed bench chip is noisy by
+design, docs/benchmarking.md Trap 3), the relative floor keeps a
+zero-variance history from flagging sub-percent jitter.
+
+Tracked metrics: ``throughput`` (img/s/chip, higher is better), ``mfu``
+(higher), ``input_wait_frac`` (share of wall time blocked on input,
+lower). Infra failures are *reported but never scored* — a down relay is
+not a regression (the BENCH_r05 lesson), and a history whose only deltas
+are infra failures exits clean.
+
+Exit-code contract (CI keys on it, like savlint's):
+
+  0 — no regression (infra failures, if any, are listed)
+  1 — at least one metric regressed
+  2 — usage or I/O error (missing file, unparseable JSON, unknown metric)
+
+Usage:
+  python tools/regression_sentinel.py BENCH_r*.json
+  python tools/regression_sentinel.py .                # dir: BENCH_*.json
+  python tools/regression_sentinel.py --json --metric throughput mfu -- *.json
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import glob
+import json
+import os
+import statistics
+import sys
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO_ROOT not in sys.path:  # runnable as a script from anywhere
+    sys.path.insert(0, _REPO_ROOT)
+
+from sav_tpu.obs.manifest import load_run_history  # noqa: E402
+
+# Scale factor turning MAD into a stdev-consistent estimator (normal dist).
+MAD_SCALE = 1.4826
+
+#: metric name -> (larger is better, absolute deviation floor). The
+#: absolute floor matters for fraction metrics whose healthy baseline is
+#: exactly 0.0 (well-overlapped runs record input_wait_frac 0.0 after the
+#: ledger's 4-decimal rounding): a zero median zeroes the *relative*
+#: floor, and without an absolute one the first 0.0002 of jitter would
+#: flag. 0.01 = one point of wall share.
+METRICS = {
+    "throughput": (True, 0.0),
+    "mfu": (True, 0.0),
+    "input_wait_frac": (False, 0.01),
+}
+
+EXIT_CLEAN, EXIT_REGRESSION, EXIT_USAGE = 0, 1, 2
+
+
+@dataclasses.dataclass
+class Verdict:
+    metric: str
+    regressed: bool
+    candidate: float
+    candidate_label: str
+    median: float
+    mad: float
+    threshold: float
+    baseline_n: int
+    reason: str
+
+
+def robust_threshold(
+    values: list, k: float, rel_floor: float, abs_floor: float = 0.0
+) -> tuple[float, float, float]:
+    """(median, MAD, allowed deviation) of a baseline series."""
+    med = statistics.median(values)
+    mad = statistics.median(abs(v - med) for v in values)
+    threshold = max(k * MAD_SCALE * mad, rel_floor * abs(med), abs_floor)
+    return med, mad, threshold
+
+
+def judge_metric(
+    records, metric: str, *, k: float, rel_floor: float, min_history: int
+):
+    """Verdict for one metric over ordered records (None = not scorable)."""
+    higher_better, abs_floor = METRICS[metric]
+    series = [
+        (r, r.metrics[metric]) for r in records
+        if r.ok and metric in r.metrics
+    ]
+    if len(series) < min_history + 1:
+        return None
+    (candidate_rec, candidate) = series[-1]
+    baseline = [v for _, v in series[:-1]]
+    med, mad, threshold = robust_threshold(baseline, k, rel_floor, abs_floor)
+    if higher_better:
+        regressed = candidate < med - threshold
+        direction = "below"
+    else:
+        regressed = candidate > med + threshold
+        direction = "above"
+    reason = (
+        f"{candidate:.6g} is {direction} the baseline median {med:.6g} "
+        f"by more than {threshold:.6g} (MAD {mad:.6g}, n={len(baseline)})"
+        if regressed
+        else f"within {threshold:.6g} of median {med:.6g} (n={len(baseline)})"
+    )
+    return Verdict(
+        metric=metric, regressed=regressed, candidate=candidate,
+        candidate_label=candidate_rec.label, median=med, mad=mad,
+        threshold=threshold, baseline_n=len(baseline), reason=reason,
+    )
+
+
+def expand_inputs(paths: list) -> list:
+    """Files stay files; a directory expands to its BENCH_*.json +
+    manifest*.json records (bench writes per-run manifest-<stamp> files)."""
+    out = []
+    for p in paths:
+        if os.path.isdir(p):
+            out.extend(sorted(glob.glob(os.path.join(p, "BENCH_*.json"))))
+            out.extend(sorted(glob.glob(os.path.join(p, "manifest*.json"))))
+        else:
+            out.append(p)
+    return out
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter,
+    )
+    parser.add_argument(
+        "paths", nargs="*",
+        help="record files (BENCH_r*.json / bench lines / manifests) or "
+        "directories (expanded to their BENCH_*.json)",
+    )
+    parser.add_argument(
+        "--metric", nargs="+", default=sorted(METRICS),
+        help=f"metrics to score (subset of {sorted(METRICS)})",
+    )
+    parser.add_argument(
+        "--threshold", type=float, default=3.5, metavar="K",
+        help="flag when the candidate deviates more than K scaled MADs "
+        "from the baseline median (3.5 is the conventional robust cut)",
+    )
+    parser.add_argument(
+        "--rel-floor", type=float, default=0.05,
+        help="minimum allowed deviation as a fraction of the median "
+        "(keeps a zero-variance baseline from flagging noise)",
+    )
+    parser.add_argument(
+        "--min-history", type=int, default=2,
+        help="baseline measurements required before a metric is scored",
+    )
+    parser.add_argument(
+        "--json", action="store_true", help="machine-readable report"
+    )
+    args = parser.parse_args(argv)
+
+    if args.min_history < 1:
+        # 0 would make the baseline empty (median of nothing) — a usage
+        # error, not a crash and not a "regression found" exit 1.
+        print(
+            f"sentinel: --min-history must be >= 1, got {args.min_history}",
+            file=sys.stderr,
+        )
+        return EXIT_USAGE
+    for metric in args.metric:
+        if metric not in METRICS:
+            print(
+                f"sentinel: unknown metric {metric!r} "
+                f"(have {sorted(METRICS)})",
+                file=sys.stderr,
+            )
+            return EXIT_USAGE
+    paths = expand_inputs(args.paths)
+    if not paths:
+        print(
+            "sentinel: no input records (pass files or a directory "
+            "containing BENCH_*.json)",
+            file=sys.stderr,
+        )
+        return EXIT_USAGE
+    try:
+        records = load_run_history(paths)
+    except (OSError, ValueError) as e:
+        print(f"sentinel: cannot read history: {e}", file=sys.stderr)
+        return EXIT_USAGE
+
+    infra = [r for r in records if not r.ok]
+    measurements = [r for r in records if r.ok]
+    verdicts = [
+        v for v in (
+            judge_metric(
+                records, m, k=args.threshold, rel_floor=args.rel_floor,
+                min_history=args.min_history,
+            )
+            for m in args.metric
+        )
+        if v is not None
+    ]
+    regressions = [v for v in verdicts if v.regressed]
+
+    if args.json:
+        print(json.dumps({
+            "records": len(records),
+            "measurements": len(measurements),
+            "infra_failures": [
+                {"label": r.label, "outcome": r.outcome, "detail": r.detail}
+                for r in infra
+            ],
+            "verdicts": [dataclasses.asdict(v) for v in verdicts],
+            "regressed": bool(regressions),
+        }, indent=2))
+    else:
+        print(
+            f"sentinel: {len(records)} records — {len(measurements)} "
+            f"measurements, {len(infra)} infra failures"
+        )
+        for r in infra:
+            print(f"  infra   {r.label}: {r.outcome} ({r.detail})")
+        for v in verdicts:
+            tag = "REGRESS" if v.regressed else "ok"
+            print(
+                f"  {tag:<7} {v.metric}: latest {v.candidate:.6g} "
+                f"({v.candidate_label}) — {v.reason}"
+            )
+        if not verdicts:
+            print(
+                "  (no metric had enough measurement history to score; "
+                f"need {args.min_history + 1} ok records)"
+            )
+    return EXIT_REGRESSION if regressions else EXIT_CLEAN
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -5,15 +5,24 @@ Reads the files the trainer writes to its log dir (train.py --log-dir;
 docs/observability.md):
 
   metrics.jsonl     — per-window step metrics (+ in-jit diagnostics)
-  goodput.json      — wall-time ledger (compile/step/input-wait/... buckets)
+  goodput.json      — wall-time ledger (compile/step/input-wait/... buckets
+                      + roofline gauges: MFU, per-group FLOPs attribution)
+  manifest.json     — run manifest (outcome taxonomy, env fingerprint)
   spans.trace.json  — host-side span trace (only its event count is shown
                       here; load the file itself in https://ui.perfetto.dev)
+
+``--bench`` additionally renders bench-record history (driver
+``BENCH_r*.json`` wrappers / raw bench lines / manifests) WITHOUT assuming
+healthy inputs: ``rc != 0`` / ``parsed: null`` records land in an "infra
+failures" section instead of crashing the report or being silently
+skipped (the BENCH_r05 lesson).
 
 Stdlib-only (no jax import): safe to run on a laptop against rsynced logs.
 
 Usage:
   python tools/run_report.py runs/vit_ti_patch16
   python tools/run_report.py --metrics some/metrics.jsonl
+  python tools/run_report.py --bench BENCH_r*.json
 """
 
 from __future__ import annotations
@@ -22,6 +31,13 @@ import argparse
 import json
 import os
 import sys
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO_ROOT not in sys.path:  # runnable as a script from anywhere
+    sys.path.insert(0, _REPO_ROOT)
+
+# Stdlib-only module (no jax) — the laptop-safety contract holds.
+from sav_tpu.obs.manifest import load_run_history  # noqa: E402
 
 
 def _fmt_seconds(s: float) -> str:
@@ -161,8 +177,36 @@ def report_goodput(summary: dict, out) -> None:
             f"max {int(feeder.get('depth_max', 0))})",
             file=out,
         )
+    # Roofline + per-group FLOPs attribution (obs/costs.py gauges): the
+    # achieved-vs-peak number the 'fast as the hardware allows' north
+    # star is falsified against, and where the step's FLOPs actually go.
+    mfu = gauges.get("mfu")
+    handled = {"mfu", "flops_per_s", "peak_flops", "peak_flops_is_fake",
+               "flops/step_per_device"}
+    if mfu is not None:
+        fake = " (FAKE cpu peak — plumbing check, not a hardware number)" \
+            if gauges.get("peak_flops_is_fake") else ""
+        print(
+            f"  Roofline: {mfu:.2%} MFU — "
+            f"{gauges.get('flops_per_s', 0.0) / 1e9:.2f} GFLOP/s achieved "
+            f"vs peak {gauges.get('peak_flops', 0.0) / 1e12:.1f} "
+            f"TFLOP/s{fake}",
+            file=out,
+        )
+    attrib = sorted(
+        (k[len("flops/"):-len("_frac")], v)
+        for k, v in gauges.items()
+        if k.startswith("flops/") and k.endswith("_frac")
+    )
+    if attrib:
+        print("  FLOPs attribution (analytic cost model):", file=out)
+        for name, frac in sorted(attrib, key=lambda kv: -kv[1]):
+            bar = "#" * int(round(40 * frac))
+            print(f"    {name:<18} {frac:>7.1%}  {bar}", file=out)
+        handled |= {f"flops/{name}_frac" for name, _ in attrib}
     other_gauges = {
-        k: v for k, v in gauges.items() if not k.startswith("feeder/")
+        k: v for k, v in gauges.items()
+        if not k.startswith("feeder/") and k not in handled
     }
     for name, value in sorted(other_gauges.items()):
         print(f"  gauge {name}: {value:g}", file=out)
@@ -182,6 +226,73 @@ def report_goodput(summary: dict, out) -> None:
         print("  no stall anomalies", file=out)
 
 
+def report_manifest(doc: dict, out) -> None:
+    outcome = doc.get("outcome", "?")
+    flag = "" if outcome == "ok" else "  <-- NOT ok"
+    print(
+        f"Manifest: {doc.get('kind', 'run')} outcome={outcome}{flag}",
+        file=out,
+    )
+    if doc.get("error"):
+        print(f"  error: {doc['error']}", file=out)
+    env = doc.get("env") or {}
+    sha = env.get("git_sha")
+    print(
+        f"  env: git {sha[:10] if sha else '?'}, "
+        f"python {env.get('python', '?')}, host {env.get('hostname', '?')}",
+        file=out,
+    )
+    notes = doc.get("notes") or {}
+    if "seq_replication_fallback" in notes:
+        info = notes["seq_replication_fallback"]
+        print(
+            f"  DEGRADED PARALLELISM: sequence-parallel batch replication "
+            f"(batch {info.get('batch')} vs data-axis product "
+            f"{info.get('data_axis_product')})",
+            file=out,
+        )
+    probe = (notes.get("backend_probe") or {})
+    if probe:
+        print(
+            f"  backend probe: {probe.get('attempts')} attempts over "
+            f"{probe.get('deadline_s')}s deadline",
+            file=out,
+        )
+
+
+def report_bench_history(paths: list, out) -> int:
+    """Render bench-record history; returns a process exit code (2 on
+    unreadable input — mirroring the sentinel's usage/IO contract)."""
+    try:
+        records = load_run_history(paths)
+    except (OSError, ValueError) as e:
+        print(f"cannot read bench records: {e}", file=sys.stderr)
+        return 2
+    ok = [r for r in records if r.ok]
+    infra = [r for r in records if not r.ok]
+    print(
+        f"Bench history: {len(records)} records — {len(ok)} measurements, "
+        f"{len(infra)} infra failures",
+        file=out,
+    )
+    for r in ok:
+        mfu = r.metrics.get("mfu")
+        extra = f", mfu {mfu:.2%}" if mfu is not None else ""
+        print(
+            f"  ok      {r.label}: "
+            f"{r.metrics.get('throughput', float('nan')):g} img/s/chip"
+            f"{extra}",
+            file=out,
+        )
+    if infra:
+        # rc != 0 / parsed: null records are INFRA, not measurements —
+        # listed, never averaged, never fatal to the report.
+        print("  infra failures (excluded from any statistics):", file=out)
+        for r in infra:
+            print(f"    {r.label}: {r.outcome} ({r.detail})", file=out)
+    return 0
+
+
 def main(argv=None) -> int:
     parser = argparse.ArgumentParser(
         description=__doc__, formatter_class=argparse.RawDescriptionHelpFormatter
@@ -192,9 +303,25 @@ def main(argv=None) -> int:
     )
     parser.add_argument("--metrics", default=None, help="explicit metrics.jsonl")
     parser.add_argument("--goodput", default=None, help="explicit goodput.json")
+    parser.add_argument(
+        "--bench", nargs="+", default=None, metavar="RECORD",
+        help="bench record files (BENCH_r*.json wrappers, raw bench JSON "
+        "lines, manifests): rendered with infra failures separated",
+    )
     args = parser.parse_args(argv)
-    if args.log_dir is None and args.metrics is None and args.goodput is None:
-        parser.error("pass a log dir, --metrics, or --goodput")
+    if (
+        args.log_dir is None and args.metrics is None
+        and args.goodput is None and args.bench is None
+    ):
+        parser.error("pass a log dir, --metrics, --goodput, or --bench")
+
+    if args.bench:
+        rc = report_bench_history(args.bench, sys.stdout)
+        if rc or (
+            args.log_dir is None and args.metrics is None
+            and args.goodput is None
+        ):
+            return rc
 
     metrics_path = args.metrics or (
         os.path.join(args.log_dir, "metrics.jsonl") if args.log_dir else None
@@ -216,6 +343,15 @@ def main(argv=None) -> int:
             report_goodput(json.load(f), out)
     elif goodput_path:
         print(f"(no goodput ledger at {goodput_path})", file=out)
+
+    if args.log_dir:
+        manifest_path = os.path.join(args.log_dir, "manifest.json")
+        if os.path.exists(manifest_path):
+            try:
+                with open(manifest_path) as f:
+                    report_manifest(json.load(f), out)
+            except json.JSONDecodeError:
+                print(f"Manifest: {manifest_path} (unreadable/torn)", file=out)
 
     if args.log_dir:
         spans = os.path.join(args.log_dir, "spans.trace.json")

@@ -213,10 +213,67 @@ import click
     "compiled programs from disk instead of re-paying multi-minute "
     "compiles (PERF.md §12: 493s for TNT).",
 )
+@click.option(
+    "--peak-flops", type=float, default=None,
+    help="Per-chip peak FLOP/s override for MFU/roofline accounting "
+    "(docs/perf_accounting.md). Default: the device-kind table; CPU "
+    "resolves to a deterministic fake peak (labeled cpu-fake in the "
+    "manifest) so the plumbing is testable off-accelerator.",
+)
 @click.option("--seed", type=int, default=42)
 @click.pass_context
-def main(
-    ctx, data_dir, fake_data, model_name, num_classes, image_size, batch_size,
+def main(ctx, **kwargs):
+    """Training CLI — thin manifest shell around :func:`_run`.
+
+    Every run writes a RunManifest (docs/perf_accounting.md) next to its
+    telemetry and finalizes it on every exit path: ok, exception
+    (classified into retrace/oom/error), watchdog fire (the watchdog
+    finalizes 'hang' itself before exit 4), and backend-unreachable
+    (require_backend_or_exit finalizes before exit 3).
+    """
+    from sav_tpu.obs.manifest import RunManifest, classify_exception
+
+    # Provisional sink: the same default resolution the config does later
+    # (_run moves the manifest if preset/config resolution changes it).
+    sink = (
+        kwargs.get("log_dir")
+        or kwargs.get("checkpoint_dir")
+        or os.path.join("runs", kwargs.get("model_name") or "run")
+    )
+    manifest = RunManifest(
+        os.path.join(sink, "manifest.json"), kind="train", argv=sys.argv[1:]
+    )
+    manifest.begin()
+    try:
+        _run(ctx, manifest, **kwargs)
+        if not manifest.finalized:
+            manifest.finalize("ok", exit_code=0)
+    except (click.ClickException, click.Abort) as e:
+        # Usage errors are still finalized — a stale 'running' manifest
+        # would read as a run that died too hard to say why.
+        manifest.finalize("error", error=repr(e), exit_code=2)
+        raise
+    except SystemExit as e:
+        # The probe path finalized 'backend_unreachable' already (finalize
+        # is first-wins), but any OTHER sys.exit — a library bailing out,
+        # a future ctx.exit — must not strand the manifest at 'running'.
+        if not manifest.finalized:
+            ok = e.code is None or e.code == 0
+            code = e.code if isinstance(e.code, int) else (0 if ok else 1)
+            manifest.finalize(
+                "ok" if ok else "error",
+                error=None if ok else f"SystemExit({e.code!r})",
+                exit_code=code,
+            )
+        raise
+    except BaseException as e:
+        manifest.finalize(classify_exception(e), error=repr(e), exit_code=1)
+        raise
+
+
+def _run(
+    ctx, manifest, data_dir, fake_data, model_name, num_classes, image_size,
+    batch_size,
     num_epochs, warmup_epochs, learning_rate, weight_decay, label_smoothing,
     ema_decay, clip_grad, grad_accum, augmentation, patch_size, backend,
     logits_dtype,
@@ -226,7 +283,7 @@ def main(
     num_eval_images, crop_min_area, train_flip, platform, backend_wait,
     fused_optimizer, log_dir, diagnostics, trace_spans, watchdog_secs,
     sanitize, device_preprocess, async_feed, feed_depth,
-    compilation_cache_dir, seed,
+    compilation_cache_dir, peak_flops, seed,
 ):
     if platform == "cpu":
         # Mirror tests/conftest.py: axon plugin *init* dials the relay even
@@ -244,7 +301,9 @@ def main(
     elif backend_wait > 0 and "pytest" not in sys.modules:
         from sav_tpu.utils.backend_probe import require_backend_or_exit
 
-        require_backend_or_exit(backend_wait, tag="train")
+        # Finalizes the manifest with outcome 'backend_unreachable' + the
+        # probe timeline before the exit-3 abort.
+        require_backend_or_exit(backend_wait, tag="train", manifest=manifest)
 
     from sav_tpu.parallel import distributed_init
     from sav_tpu.train import TrainConfig, Trainer, get_preset
@@ -264,6 +323,10 @@ def main(
     # from TF as well — both orderings are defended).
     distributed_init()
     n_devices = len(jax.devices())
+    if jax.process_index() != 0:
+        # Multi-host runs share the log dir; only process 0 owns the
+        # manifest file (same rule as the goodput/span writers).
+        manifest.disable()
 
     from sav_tpu.data.pipeline import Split, load
 
@@ -317,6 +380,7 @@ def main(
         async_feed=async_feed,
         feed_depth=feed_depth,
         compilation_cache_dir=compilation_cache_dir,
+        peak_flops=peak_flops,
         mesh_axes=mesh_axes,
         sequence_parallel=sp_method if sp > 1 else None,
         pipeline_parallel=pp if pp > 1 else None,
@@ -353,6 +417,7 @@ def main(
             "device_preprocess": "device_preprocess",
             "async_feed": "async_feed", "feed_depth": "feed_depth",
             "compilation_cache_dir": "compilation_cache_dir",
+            "peak_flops": "peak_flops",
             "log_dir": "log_dir", "diagnostics": "diagnostics",
             "trace_spans": "trace_spans", "watchdog_secs": "watchdog_secs",
             "sanitize": "sanitize",
@@ -418,6 +483,13 @@ def main(
             log_dir=config.checkpoint_dir
             or os.path.join("runs", config.model_name),
         )
+    # The final config may have re-homed the telemetry sink (preset /
+    # checkpoint-dir fallback): the manifest follows it, and from here on
+    # carries the fully resolved config.
+    import dataclasses as _dc
+
+    manifest.move_to(os.path.join(config.log_dir, "manifest.json"))
+    manifest.set_config(_dc.asdict(config))
     # Refresh locals the data pipeline uses from the final config.
     model_name = config.model_name
     image_size = config.image_size
@@ -500,6 +572,10 @@ def main(
         metrics = trainer.evaluate(state, eval_iter)
         if jax.process_index() == 0:
             click.echo(json.dumps({"step": start_step, **metrics}))
+        manifest.finalize(
+            "ok", exit_code=0,
+            metrics={k: float(v) for k, v in metrics.items()},
+        )
         return
     if fake_data:
         train_iter = load(
@@ -553,6 +629,7 @@ def main(
             eval_iter_fn=None if fake_data else eval_iter_fn,
             state=state,
             log_fn=log_fn,
+            manifest=manifest,
         )
     finally:
         if writer is not None:

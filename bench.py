@@ -120,9 +120,25 @@ def _feed_iterator(feed, batch_size, image_size, tmpdir, device_preprocess=False
     raise ValueError(feed)
 
 
+def _record_window(recorder, step, loss_val, result):
+    """One bench window through the flight recorder's gates (shared by the
+    synthetic and fed loops): pair the window with its context, run the
+    nonfinite/spike detection on the already-synced loss, and stash the
+    first incident pointer + trigger into the result dict."""
+    if recorder is None:
+        return
+    recorder.on_step(step)
+    trig = recorder.note_metrics(step, {"loss": loss_val})
+    if trig:
+        inc = recorder.dump_incident(trig, step)
+        if inc:
+            result.setdefault("incident", inc)
+            result.setdefault("incident_trigger", trig)
+
+
 def run(model_name, batch_size, steps, backend, image_size, reps, feed,
         device_preprocess=False, async_feed=True, compilation_cache_dir=None,
-        peak_flops=None):
+        peak_flops=None, record=False, record_dir=None):
     import jax
 
     from sav_tpu.data import synthetic_data_iterator
@@ -159,6 +175,21 @@ def run(model_name, batch_size, steps, backend, image_size, reps, feed,
     state = trainer.init_state()
     rng = jax.random.PRNGKey(0)
     result: dict = {}
+    recorder = None
+    if record:
+        # Flight recorder at *window* granularity (off by default — bench
+        # measures the hot loop and must not instrument inside it): a
+        # pre-window state snapshot + the window's loss through the
+        # nonfinite/spike gates. A NaN'd bench then carries an incident
+        # pointer in its JSON line instead of just a wrong-looking number
+        # (docs/incident_replay.md). Window entries are step-sparse, so
+        # bundles honestly come out replayable: false.
+        from sav_tpu.obs.recorder import FlightRecorder
+
+        recorder = FlightRecorder.from_config(
+            trainer.config, record_dir or "runs/bench",
+            depth=max(reps, 2), keep_batches=max(reps, 2), snapshot_every=1,
+        )
     # Roofline accounting (sav_tpu/obs/costs.py): the synthetic branch
     # upgrades this analytic estimate with the AOT executable's exact XLA
     # cost analysis; the fed branches keep the analytic fallback (their
@@ -200,13 +231,17 @@ def run(model_name, batch_size, steps, backend, image_size, reps, feed,
 
         windows = []
         for rep in range(reps):
+            if recorder is not None:
+                recorder.snapshot(rep * steps, jax.device_get(state))
+                recorder.observe_batch(batch)
             t0 = time.perf_counter()
             for _ in range(steps):
                 state, metrics = step(state, sharded, rng)
-            float(jax.device_get(metrics["loss"]))
+            loss_val = float(jax.device_get(metrics["loss"]))
             elapsed = time.perf_counter() - t0
             ledger.note_window(steps, elapsed, step=(rep + 1) * steps)
             windows.append(elapsed / steps)
+            _record_window(recorder, (rep + 1) * steps, loss_val, result)
     else:
         import tempfile
 
@@ -280,13 +315,18 @@ def run(model_name, batch_size, steps, backend, image_size, reps, feed,
         windows = []
         try:
             for rep in range(reps):
+                if recorder is not None:
+                    recorder.snapshot(rep * steps, jax.device_get(state))
                 t0 = time.perf_counter()
                 for _ in range(steps):
                     state, metrics = trainer.train_step_placed(
                         state, next_placed(), rng
                     )
-                float(jax.device_get(metrics["loss"]))
+                loss_val = float(jax.device_get(metrics["loss"]))
                 elapsed = time.perf_counter() - t0
+                _record_window(
+                    recorder, (rep + 1) * steps, loss_val, result
+                )
                 # Fed windows interleave host fetch + transfer + device
                 # step; the ledger books them as 'step' (end-to-end
                 # goodput), with the host-only and transfer shares
@@ -299,6 +339,9 @@ def run(model_name, batch_size, steps, backend, image_size, reps, feed,
                     ledger.set_gauge(f"feeder/{k}", v)
                 feeder.close()
 
+    if recorder is not None:
+        for k, v in recorder.stats().items():
+            ledger.set_gauge(f"recorder/{k}", v)
     n_chips = len(jax.devices())
     best = min(windows)
     # Cost-model attribution + roofline (docs/perf_accounting.md):
@@ -436,6 +479,15 @@ def main(argv=None):
         "a deterministic fake peak on CPU (labeled cpu-fake)",
     )
     parser.add_argument(
+        "--record", action="store_true",
+        help="flight recorder at window granularity (off by default so "
+        "the measured loop stays uninstrumented): pre-window state "
+        "snapshots + the window losses through the nonfinite/spike "
+        "gates; a NaN'd bench then carries an 'incident' bundle pointer "
+        "in its JSON line and finalizes outcome: nonfinite "
+        "(docs/incident_replay.md)",
+    )
+    parser.add_argument(
         "--manifest", default=None,
         help="run-manifest path (sav_tpu/obs/manifest.py): written at "
         "start, finalized with a machine-readable outcome on every exit "
@@ -478,6 +530,8 @@ def main(argv=None):
             async_feed=not args.no_async_feed,
             compilation_cache_dir=args.compilation_cache_dir,
             peak_flops=args.peak_flops,
+            record=args.record,
+            record_dir=os.path.dirname(args.manifest) or "runs/bench",
         )
     except BaseException as e:
         # Every exit path stays parseable: classify (oom/error/...), put
@@ -503,6 +557,15 @@ def main(argv=None):
     import jax
 
     manifest_metrics = extra.pop("_manifest_metrics", {})
+    # A recorded NONFINITE incident demotes the outcome: the regression
+    # sentinel must never score a diverged run's throughput as a
+    # measurement. A finite loss_spike incident keeps outcome ok — the
+    # timing numbers are still real measurements — but the bundle pointer
+    # rides the JSON line and manifest either way.
+    outcome = (
+        "nonfinite" if extra.get("incident_trigger") == "nonfinite"
+        else "ok"
+    )
     out = {
         "metric": f"{args.model} train img/s/chip (bs={args.batch_size}, "
         f"bf16, {args.backend} attention, {feed_desc} feed, {n_chips} chip, "
@@ -513,13 +576,15 @@ def main(argv=None):
         # Makes a silent CPU fallback visible in the recorded JSON — the
         # number is only comparable to the baseline on a real accelerator.
         "platform": jax.devices()[0].platform,
-        "outcome": "ok",
+        "outcome": outcome,
         "manifest": manifest.path,
     }
     out.update(extra)
+    notes = {"metric": out["metric"], "platform": out["platform"]}
+    if extra.get("incident"):
+        notes["incident"] = extra["incident"]
     manifest.finalize(
-        "ok", exit_code=0, metrics=manifest_metrics,
-        notes={"metric": out["metric"], "platform": out["platform"]},
+        outcome, exit_code=0, metrics=manifest_metrics, notes=notes,
     )
     print(json.dumps(out))
     return 0

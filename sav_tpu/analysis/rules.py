@@ -799,6 +799,118 @@ class AdhocSeedDerivation(Rule):
                 )
 
 
+# ---------------------------------------------------------------- SAV111
+
+
+class RecorderHotLoopSync(Rule):
+    """Host sync on step metrics inside the recorded hot loop.
+
+    The flight recorder's steady-state contract (sav_tpu/obs/recorder.py,
+    docs/incident_replay.md) is that recording adds **no per-step device
+    syncs**: the per-step path (``observe_batch``/``on_step``) is host
+    bookkeeping only, and detection (``note_metrics``) runs on metrics
+    the trainer *already* ``device_get``'d at its log boundary. Two ways
+    an edit silently breaks that: a sync call slipped into one of the
+    recorder's per-step functions (they are outside SAV101's
+    fit/evaluate scope, so SAV111 owns them), or a ``float(metrics)`` /
+    ``int(metric_dict)`` on a bare metrics-named value in the hot loop —
+    a device scalar pulled to host through ``__float__``, invisible to
+    SAV101's subscript/attribute heuristic. Sanctioned sync points carry
+    the usual justification pragma.
+    """
+
+    id = "SAV111"
+    name = "recorder-hot-loop-sync"
+    severity = "error"
+    hint = (
+        "keep the recorder's per-step path host-only (detection rides the "
+        "trainer's existing log-boundary device_get); if this sync is the "
+        "sanctioned periodic snapshot, pragma it with a justification"
+    )
+
+    # The recorder's per-step surface: judged like the trainer's hot loop,
+    # but by this rule (SAV101's HOT_FUNCTIONS stays fit/evaluate/steps).
+    RECORDER_FUNCTIONS = frozenset(
+        {"observe_batch", "on_step", "note_metrics", "wrap_place"}
+    )
+
+    def _metric_root(self, node) -> bool:
+        """True when the expression is rooted at a metrics-named value."""
+        while isinstance(node, (ast.Attribute, ast.Subscript)):
+            node = node.value
+        return isinstance(node, ast.Name) and "metric" in node.id.lower()
+
+    def check(self, module):
+        scope = HOT_FUNCTIONS | self.RECORDER_FUNCTIONS
+        for fn in module.functions:
+            if fn.name not in scope:
+                continue
+            recorder_scope = fn.name in self.RECORDER_FUNCTIONS
+            for node in _walk_excluding_nested(fn):
+                if not isinstance(node, ast.Call):
+                    continue
+                # float()/int() on a bare metrics-named value: the
+                # implicit-__float__ sync SAV101's subscript/attribute
+                # check cannot see. Flagged in both scopes.
+                if (
+                    isinstance(node.func, ast.Name)
+                    and node.func.id in ("float", "int")
+                    and len(node.args) == 1
+                    and isinstance(node.args[0], ast.Name)
+                    and "metric" in node.args[0].id.lower()
+                ):
+                    yield _finding(
+                        self,
+                        node,
+                        f"{node.func.id}() on step metrics in "
+                        f"{fn.name}() implicitly syncs a device scalar "
+                        "to host",
+                    )
+                    continue
+                if not recorder_scope:
+                    continue  # in fit/evaluate the rest is SAV101's beat
+                resolved = module.resolve_call(node)
+                if resolved in HostSyncInHotLoop.SYNC_CALLS:
+                    yield _finding(
+                        self,
+                        node,
+                        f"{HostSyncInHotLoop.SYNC_CALLS[resolved]} in "
+                        f"recorder hot path {fn.name}() — recording must "
+                        "not add per-step syncs",
+                    )
+                    continue
+                if (
+                    isinstance(node.func, ast.Attribute)
+                    and node.func.attr in HostSyncInHotLoop.SYNC_METHODS
+                    and not node.args
+                    and not node.keywords
+                ):
+                    yield _finding(
+                        self,
+                        node,
+                        f"{HostSyncInHotLoop.SYNC_METHODS[node.func.attr]}"
+                        f" in recorder hot path {fn.name}() — recording "
+                        "must not add per-step syncs",
+                    )
+                    continue
+                if (
+                    isinstance(node.func, ast.Name)
+                    and node.func.id in ("float", "int")
+                    and len(node.args) == 1
+                    and isinstance(
+                        node.args[0], (ast.Subscript, ast.Attribute)
+                    )
+                    and self._metric_root(node.args[0])
+                ):
+                    yield _finding(
+                        self,
+                        node,
+                        f"{node.func.id}() on a metrics subscript/attribute "
+                        f"in recorder hot path {fn.name}() implicitly "
+                        "syncs a device scalar to host",
+                    )
+
+
 # ----------------------------------------------------------- SAV100 (meta)
 
 
@@ -860,6 +972,7 @@ ALL_RULES = [
     F32LiteralPromotion(),
     JitInLoop(),
     AdhocSeedDerivation(),
+    RecorderHotLoopSync(),
 ]
 
 

@@ -23,6 +23,10 @@ Five signals, one design rule each:
 - :mod:`sav_tpu.obs.manifest` — structured run manifests finalized with a
   machine-readable outcome on every exit path, plus the normalized
   run-record reading shared by the report/sentinel tools.
+- :mod:`sav_tpu.obs.recorder` — flight recorder: bounded ring of host-side
+  step context (batch hash/raw batches, rng recipe, metrics, periodic
+  state snapshots) dumped as a replayable incident bundle on nonfinite
+  metrics, loss spikes, hangs, or crashes (``tools/replay_step.py``).
 
 Re-exports are lazy (PEP 562, same pattern as :mod:`sav_tpu.utils`):
 :mod:`spans`, :mod:`goodput`, and :mod:`watchdog` are stdlib-only and must
@@ -44,6 +48,7 @@ _EXPORTS = {
     "StepCost": "sav_tpu.obs.costs",
     "resolve_peak_flops": "sav_tpu.obs.costs",
     "train_step_cost": "sav_tpu.obs.costs",
+    "FlightRecorder": "sav_tpu.obs.recorder",
     "RunManifest": "sav_tpu.obs.manifest",
     "RunRecord": "sav_tpu.obs.manifest",
     "classify_exception": "sav_tpu.obs.manifest",
@@ -55,7 +60,7 @@ __all__ = list(_EXPORTS)
 
 _SUBMODULES = frozenset(
     {"diagnostics", "spans", "goodput", "memory", "watchdog", "costs",
-     "manifest"}
+     "manifest", "recorder"}
 )
 
 

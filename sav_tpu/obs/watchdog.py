@@ -62,9 +62,17 @@ class HangWatchdog:
     ``outcome: "hang"`` *before* the process exits — the hang must be
     machine-visible in the run record, not only in a stderr dump
     (``os._exit`` skips every atexit/finally, so nothing downstream gets
-    another chance). ``exit_fn``/``stream`` are injectable for tests —
-    production uses ``os._exit`` so a wedged main thread cannot swallow
-    the abort.
+    another chance). ``recorder``: optional
+    :class:`~sav_tpu.obs.recorder.FlightRecorder` — its incident bundle
+    (trigger ``hang``: the ring's last steps, kept batches, nearest state
+    snapshot) is dumped before the manifest is finalized, and the bundle
+    path rides the manifest's finalize notes, for the same reason: after
+    ``os._exit`` nothing gets another chance. The dump runs on a side
+    thread bounded by ``dump_timeout_s`` (default 30 s): the log dir's
+    filesystem may be the hang's own cause, and the guaranteed-exit
+    contract outranks telemetry. ``exit_fn``/``stream`` are
+    injectable for tests — production uses ``os._exit`` so a wedged main
+    thread cannot swallow the abort.
     """
 
     def __init__(
@@ -73,22 +81,26 @@ class HangWatchdog:
         *,
         ledger=None,
         manifest=None,
+        recorder=None,
         tag: str = "watchdog",
         exit_code: int = WATCHDOG_EXIT_CODE,
         exit_fn: Optional[Callable[[int], None]] = None,
         stream=None,
         poll_s: Optional[float] = None,
+        dump_timeout_s: float = 30.0,
     ):
         if deadline_s <= 0:
             raise ValueError(f"deadline_s must be positive, got {deadline_s}")
         self.deadline_s = deadline_s
         self.ledger = ledger
         self.manifest = manifest
+        self.recorder = recorder
         self.tag = tag
         self.exit_code = exit_code
         self._exit_fn = exit_fn if exit_fn is not None else os._exit
         self._stream = stream
         self._poll_s = poll_s if poll_s is not None else min(deadline_s / 4, 5.0)
+        self._dump_timeout_s = dump_timeout_s
         self._last_beat = time.monotonic()
         self._stop = threading.Event()
         self.fired = threading.Event()
@@ -146,10 +158,53 @@ class HangWatchdog:
                 )
         except Exception as e:  # diagnostics must not mask the abort
             print(f"{self.tag}: dump failed: {e!r}", file=stream)
-        # Finalize the run manifest BEFORE exiting: os._exit skips every
-        # finally/atexit, so this is the record's only chance to say
-        # 'hang' instead of staying 'running'. Own try so a manifest I/O
-        # failure cannot mask the abort either.
+        # Flight-recorder bundle BEFORE the manifest finalize, both BEFORE
+        # exiting: os._exit skips every finally/atexit, so this is the only
+        # chance for the hang's context (last steps, batches, snapshot) to
+        # reach disk and for the manifest to point at it. The dump is
+        # unbounded file I/O to the very log_dir whose filesystem may BE
+        # the hang's cause — so it runs on a bounded side thread: if the
+        # write wedges, the abort proceeds anyway (the watchdog's
+        # guaranteed-exit contract outranks its telemetry).
+        incident_path = None
+        if self.recorder is not None:
+            dumped: dict = {}
+
+            def _dump():
+                try:
+                    dumped["path"] = self.recorder.dump_incident(
+                        "hang",
+                        error=(
+                            f"{self.tag}: no step completed in "
+                            f"{silent_s:.0f}s"
+                        ),
+                    )
+                except Exception as e:
+                    dumped["error"] = e
+            dumper = threading.Thread(
+                target=_dump, name=f"{self.tag}-dump", daemon=True
+            )
+            dumper.start()
+            dumper.join(timeout=self._dump_timeout_s)
+            incident_path = dumped.get("path")
+            if dumper.is_alive():
+                print(
+                    f"{self.tag}: recorder dump still blocked after "
+                    f"{self._dump_timeout_s:.0f}s (wedged filesystem?); "
+                    "aborting without it",
+                    file=stream,
+                )
+            elif "error" in dumped:
+                print(
+                    f"{self.tag}: recorder dump failed: "
+                    f"{dumped['error']!r}",
+                    file=stream,
+                )
+            elif incident_path:
+                print(
+                    f"{self.tag}: incident bundle: {incident_path}",
+                    file=stream,
+                )
         try:
             if self.manifest is not None:
                 metrics = None
@@ -163,6 +218,9 @@ class HangWatchdog:
                     ),
                     exit_code=self.exit_code,
                     metrics=metrics,
+                    notes=(
+                        {"incident": incident_path} if incident_path else None
+                    ),
                 )
         except Exception as e:
             print(f"{self.tag}: manifest finalize failed: {e!r}", file=stream)

@@ -158,6 +158,27 @@ class TrainConfig:
     # CPU resolves to a deterministic fake peak (labeled 'cpu-fake') so
     # the attribution/MFU plumbing stays assertable in tier-1.
     peak_flops: Optional[float] = None
+    # Flight recorder (sav_tpu.obs.recorder; docs/incident_replay.md):
+    # keep a bounded ring of the last record_depth steps' host-side
+    # context (batch content hash + shapes/dtypes, rng recipe, logged
+    # metrics) plus the raw host batches of the newest record_batches
+    # steps and a periodic pre-step TrainState snapshot every
+    # record_snapshot_every steps (None = record_batches). On an incident
+    # — nonfinite logged metrics, a loss spike beyond spike_sigma scaled
+    # MADs, a watchdog hang, or an uncaught exception — fit() dumps a
+    # replayable bundle under <log_dir>/incidents/step_<N>/ for
+    # tools/replay_step.py. Steady-state cost is host-only bookkeeping
+    # (no extra device syncs; savlint SAV111 enforces); the periodic
+    # snapshot is the one pipeline drain recording adds.
+    record: bool = False
+    record_depth: int = 16
+    record_batches: int = 4
+    record_snapshot_every: Optional[int] = None
+    # Loss-spike incident gate: flag a logged loss more than spike_sigma
+    # scaled MADs above the rolling median of healthy windows (upward
+    # only; 0 disables). Armed after 8 healthy windows so early-training
+    # noise cannot false-fire.
+    spike_sigma: float = 6.0
     # Runtime sanitizers (sav_tpu.analysis.sanitize;
     # docs/static_analysis.md): after the first completed step, arm
     # jax.transfer_guard_host_to_device("disallow") on the training

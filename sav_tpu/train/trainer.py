@@ -17,7 +17,9 @@ called it).
 from __future__ import annotations
 
 import json
+import math
 import os
+import sys
 import time
 import warnings
 from collections import deque
@@ -719,7 +721,13 @@ class Trainer:
         )
         return out
 
-    def evaluate(self, state: TrainState, eval_iter: Iterator[dict]) -> dict:
+    def evaluate(
+        self,
+        state: TrainState,
+        eval_iter: Iterator[dict],
+        *,
+        recorder=None,
+    ) -> dict:
         """Run one evaluation pass over ``eval_iter``.
 
         The loop is pipelined like fit()'s (config.async_feed): pad+place
@@ -728,6 +736,13 @@ class Trainer:
         device until one ``device_get`` at the end — the old per-batch
         synchronous fetch + sync serialized every stage and inflated eval
         windows on slow-transfer rigs (PERF.md §7).
+
+        Numerics guards mirror fit()'s: a nonfinite eval metric dumps a
+        flight-recorder incident bundle (``recorder`` — fit() passes its
+        own so mid-run evals share the training ring; standalone evals
+        build a fresh one when ``config.record``) and, under
+        ``config.debug_nans``, raises ``FloatingPointError`` naming the
+        bad keys.
         """
         batch_size: Optional[int] = None
         data_div = int(np.prod([self.mesh.shape[a] for a in batch_axes(self.mesh)]))
@@ -785,12 +800,37 @@ class Trainer:
             for k, v in sums.items():
                 totals[k] = totals.get(k, 0.0) + float(v)
         n = max(totals.get("count", 0.0), 1.0)
-        return {
+        results = {
             "eval_loss": totals.get("loss_sum", 0.0) / n,
             "eval_top_1_acc": totals.get("top_1_sum", 0.0) / n,
             "eval_top_5_acc": totals.get("top_5_sum", 0.0) / n,
             "eval_count": n,
         }
+        bad = sorted(k for k, v in results.items() if not math.isfinite(v))
+        if bad:
+            if (
+                recorder is None
+                and cfg.record
+                and jax.process_index() == 0
+            ):
+                # Standalone eval (train.py --eval-only): no training ring
+                # exists, but a nonfinite eval loss still gets a bundle
+                # (trigger + metrics + config) for the record.
+                from sav_tpu.obs.recorder import FlightRecorder
+
+                recorder = FlightRecorder.from_config(
+                    cfg, cfg.log_dir or cfg.checkpoint_dir or "."
+                )
+            if recorder is not None:
+                recorder.dump_incident(
+                    "eval_nonfinite",
+                    extra={"eval": results, "bad_keys": bad},
+                )
+            if cfg.debug_nans:
+                raise FloatingPointError(
+                    f"non-finite values in eval metrics: {bad}"
+                )
+        return results
 
     def fit(
         self,
@@ -866,6 +906,19 @@ class Trainer:
             from sav_tpu.analysis.sanitize import StepSanitizer
 
             sanitizer = StepSanitizer(self._train_step, tag="train-sanitize")
+        recorder = None
+        if cfg.record and obs_writer:
+            # Flight recorder (sav_tpu.obs.recorder; docs/incident_replay.md):
+            # host-side ring of step context + raw batches + periodic
+            # pre-step snapshots, dumped as a replayable incident bundle
+            # on nonfinite metrics / loss spikes / hangs / crashes. The
+            # per-step path is sync-free (SAV111); the periodic snapshot
+            # below is the one pipeline drain recording adds.
+            from sav_tpu.obs.recorder import FlightRecorder
+
+            recorder = FlightRecorder.from_config(
+                cfg, obs_dir or ".", manifest=manifest
+            )
         watchdog = None
         if cfg.watchdog_secs:
             from sav_tpu.obs.watchdog import HangWatchdog
@@ -876,7 +929,7 @@ class Trainer:
             # slowest of those, not just above the step time.
             watchdog = HangWatchdog(
                 cfg.watchdog_secs, ledger=ledger, tag="train-watchdog",
-                manifest=manifest,
+                manifest=manifest, recorder=recorder,
             )
         # Cost model (sav_tpu/obs/costs.py): an analytic per-layer-group
         # FLOPs estimate exists up front on any backend; the total is
@@ -985,8 +1038,16 @@ class Trainer:
             # replays from the checkpointed step, not iterator position).
             from sav_tpu.data.feeder import DeviceFeeder
 
+            # With the recorder on, the place callback additionally
+            # fingerprints + retains the host batch on the feeder's
+            # thread — hashing overlaps device compute like the placement
+            # itself does.
+            place_fn = (
+                recorder.wrap_place(self.shard_batch)
+                if recorder is not None else self.shard_batch
+            )
             feeder = DeviceFeeder(
-                data_iter, self.shard_batch, depth=cfg.feed_depth,
+                data_iter, place_fn, depth=cfg.feed_depth,
                 name="train-feeder",
             )
         # Dispatch run-ahead bound (see the step_dispatch block below);
@@ -1023,9 +1084,16 @@ class Trainer:
                             batch = next(data_iter)
                         except StopIteration:
                             break
+                    if recorder is not None:
+                        recorder.observe_batch(batch)
                     with tracer.span("shard_batch", step=step + 1), \
                             ledger.measure("h2d"):
                         sharded = self.shard_batch(batch)  # savlint: disable=SAV106 -- the sanctioned serial fallback (async_feed=False)
+                if recorder is not None and recorder.wants_snapshot(step):
+                    # The one sync recording adds: a periodic pre-step state
+                    # copy (every record_snapshot_every steps) so an
+                    # incident bundle can replay from a nearby step.
+                    recorder.snapshot(step, jax.device_get(state))  # savlint: disable=SAV101 -- periodic pre-step recorder snapshot at the configured cadence, not a per-step sync
                 if use_aot and compiled_step is None:
                     from sav_tpu.utils.flops import compiled_flops
 
@@ -1075,6 +1143,10 @@ class Trainer:
                     jax.block_until_ready(  # savlint: disable=SAV101 -- run-ahead cap: device-compute wait that retires placed inputs
                         inflight_metrics.popleft()
                     )
+                if recorder is not None:
+                    # Host-only bookkeeping (pairs the dispatched step with
+                    # its observed batch); never touches device values.
+                    recorder.on_step(step + 1)
                 dispatch_s = time.perf_counter() - t_step
                 if step == start_step and compiled_step is None:
                     # The first jit dispatch blocks through trace+compile;
@@ -1136,13 +1208,29 @@ class Trainer:
                     history.append(m)
                     if log_fn is not None:
                         log_fn(m)
+                    if recorder is not None:
+                        # Incident detection piggybacks on the metrics this
+                        # window already synced: nonfinite values or a loss
+                        # beyond the robust spike gate dump a bundle.
+                        trigger = recorder.note_metrics(step + 1, m)
+                        if trigger:
+                            incident = recorder.dump_incident(
+                                trigger, step + 1
+                            )
+                            if incident is not None:
+                                tracer.instant(
+                                    "incident", step=step + 1,
+                                    trigger=trigger,
+                                )
                 epoch_done = (step + 1) % cfg.steps_per_epoch == 0
                 if epoch_done:
                     epoch = (step + 1) // cfg.steps_per_epoch
                     if eval_iter_fn is not None and epoch % cfg.eval_every_epochs == 0:
                         with tracer.span("eval", epoch=epoch), \
                                 ledger.measure("eval"):
-                            em = self.evaluate(state, eval_iter_fn())
+                            em = self.evaluate(
+                                state, eval_iter_fn(), recorder=recorder
+                            )
                         em["step"] = step + 1
                         history.append(em)
                         if log_fn is not None:
@@ -1194,6 +1282,33 @@ class Trainer:
                 with ledger.measure("checkpoint"):
                     self.checkpointer.wait()
         finally:
+            if recorder is not None:
+                exc = sys.exc_info()[1]
+                # Skip when the failure already dumped on the way out (a
+                # nonfinite mid-fit eval dumps 'eval_nonfinite' and THEN
+                # raises under debug_nans) — a second bundle at the same
+                # step would just burn the incident budget on a copy.
+                already_dumped = bool(recorder.incidents) and (
+                    recorder.incidents[-1]["step"]
+                    == (recorder.last_step or 0)
+                )
+                if (
+                    exc is not None
+                    and not isinstance(exc, StopIteration)
+                    and not already_dumped
+                ):
+                    # The crash path: dump whatever context the ring holds
+                    # so the failing step is reproducible even when nothing
+                    # upstream detected it (debug_nans raises per-step,
+                    # before the log-boundary detection ever sees it).
+                    recorder.dump_incident(
+                        "nonfinite"
+                        if isinstance(exc, FloatingPointError)
+                        else "exception",
+                        error=repr(exc),
+                    )
+                for k, v in recorder.stats().items():
+                    ledger.set_gauge(f"recorder/{k}", v)
             if feeder is not None:
                 # Publish the worker-side counters as ledger gauges (they
                 # are overlapped background time + queue depths, not

@@ -77,3 +77,36 @@ def enable_persistent_cache(
             "persistent cache only applies if nothing compiled yet"
         )
     return True
+
+
+def disable_persistent_cache() -> None:
+    """Turn the persistent cache fully off — the symmetric inverse of
+    :func:`enable_persistent_cache`.
+
+    Clearing ``jax_compilation_cache_dir`` alone is NOT enough: jax's
+    cache singleton froze its enable decision at the first compilation
+    after :func:`enable_persistent_cache`'s reset, so the live cache
+    object keeps serving the old directory — later identical programs
+    come back as *deserialized* executables from a path the caller
+    believes is disabled (and on the CPU backend that deserialized-hit
+    path has crashed outright: the flight-recorder replay of a
+    just-recorded step is exactly a same-process identical recompile).
+    Callers that enable the cache temporarily (tests, notebooks) must
+    tear down through here.
+    """
+    import jax
+
+    try:
+        jax.config.update("jax_compilation_cache_dir", None)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+    except (AttributeError, ValueError):  # pragma: no cover - old jax
+        pass
+    try:
+        from jax._src import compilation_cache as _cc
+
+        _cc.reset_cache()  # drop the frozen, still-live cache object
+    except Exception:  # pragma: no cover - private API moved
+        logging.warning(
+            "could not reset jax's compilation-cache singleton; the old "
+            "cache directory may keep serving this process's compiles"
+        )

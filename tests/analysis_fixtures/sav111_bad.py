@@ -1,0 +1,21 @@
+"""Known-bad fixture for SAV111: host syncs on step metrics in the
+recorded hot loop — float() on a bare metrics name in fit(), and sync
+calls inside the recorder's per-step functions (outside SAV101's scope)."""
+import jax
+
+
+def fit(model, batches):
+    metrics = None
+    for batch in batches:
+        state, metrics = model.step(batch)
+        loss = float(metrics)
+    return loss
+
+
+class Recorder:
+    def on_step(self, step, metrics):
+        self.ring.append(jax.device_get(metrics))
+
+    def note_metrics(self, step, metrics):
+        self.window.append(metrics["loss"].item())
+        self.norm = float(metrics["grad_norm"])

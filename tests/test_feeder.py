@@ -411,7 +411,10 @@ def test_compilation_cache_dir_persists_compiles(tmp_path, devices):
     """TrainConfig.compilation_cache_dir routes compiles through the
     persistent XLA cache: after one step, the directory holds entries
     (what makes the 493 s TNT recompile a disk read on round trips)."""
-    from sav_tpu.utils.compile_cache import enable_persistent_cache
+    from sav_tpu.utils.compile_cache import (
+        disable_persistent_cache,
+        enable_persistent_cache,
+    )
 
     cache_dir = str(tmp_path / "xla_cache")
     try:
@@ -425,5 +428,10 @@ def test_compilation_cache_dir_persists_compiles(tmp_path, devices):
         jax.block_until_ready(state)
         assert os.listdir(cache_dir), "no persistent cache entries written"
     finally:
-        jax.config.update("jax_compilation_cache_dir", None)
-        jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+        # Full teardown, not just the config flag: jax's cache singleton
+        # froze its decision at the compile above, and a leaked live
+        # cache would keep serving THIS tmp dir to every later test that
+        # recompiles an identical program (the flight-recorder replay
+        # test does exactly that — and the deserialized-hit path has
+        # segfaulted the CPU backend).
+        disable_persistent_cache()

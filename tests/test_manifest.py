@@ -147,7 +147,20 @@ def test_classify_exception_taxonomy():
     ) == "oom"
     assert classify_exception(MemoryError()) == "oom"
     assert classify_exception(ValueError("bad shape")) == "error"
-    for outcome in ("retrace", "oom", "error"):
+    # NaN/Inf deaths are their own outcome (ISSUE 5): the trainer's
+    # debug_nans assert, any "non-finite" message, and checkify's
+    # nan_checks error all classify as nonfinite — never as plain error,
+    # so the sentinel can list them as scored-never without scraping text.
+    assert classify_exception(
+        FloatingPointError("non-finite values in metrics at step 7")
+    ) == "nonfinite"
+    assert classify_exception(
+        RuntimeError("non-finite values in eval metrics: ['eval_loss']")
+    ) == "nonfinite"
+    assert classify_exception(
+        ValueError("nan generated by primitive: sub.")
+    ) == "nonfinite"
+    for outcome in ("retrace", "oom", "nonfinite", "error"):
         assert outcome in OUTCOMES
 
 

@@ -1,0 +1,460 @@
+"""Flight recorder + incident dumps + deterministic replay (ISSUE 5).
+
+The tier-1 acceptance criteria live here: a seeded run with a planted
+NaN (scaled-up lr on synthetic data) produces an incident bundle,
+``tools/replay_step.py`` reproduces the recorded step metrics
+**bit-exactly** on CPU and names the first nonfinite layer group, a
+healthy run of equal length produces zero incidents with recorder
+overhead under 2% of step time (asserted via the goodput ledger), and
+the crash paths — eval nonfinite, watchdog hang, uncaught exception —
+all dump bundles before the process can lose them. Unit coverage pins
+the ring/batch retention bounds and the spike/nonfinite gates.
+"""
+
+import importlib.util
+import json
+import io
+import os
+import sys
+
+import numpy as np
+import pytest
+
+from sav_tpu.obs.recorder import FlightRecorder, load_bundle_batch
+from sav_tpu.data import synthetic_data_iterator
+from sav_tpu.train import TrainConfig, Trainer
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _load_replay():
+    path = os.path.join(ROOT, "tools", "replay_step.py")
+    spec = importlib.util.spec_from_file_location("replay_step", path)
+    module = importlib.util.module_from_spec(spec)
+    sys.modules[spec.name] = module
+    spec.loader.exec_module(module)
+    return module
+
+
+replay_step = _load_replay()
+
+
+def _config(tmp_path, **overrides):
+    base = dict(
+        model_name="vit_ti_patch16",
+        num_classes=10,
+        image_size=32,
+        compute_dtype="float32",
+        global_batch_size=8,
+        num_train_images=8 * 32,
+        num_epochs=1,
+        warmup_epochs=0,
+        lr_scaling_divisor=8,
+        base_lr=1e-3,
+        clip_grad_norm=None,
+        transpose_images=False,
+        log_every_steps=1,
+        log_dir=str(tmp_path),
+        diagnostics=True,
+        record=True,
+        record_depth=8,
+        record_batches=4,
+        seed=0,
+        # The model is rebuilt from this config by tools/replay_step.py,
+        # so the architecture must live in model_overrides, not in an
+        # externally constructed model.
+        model_overrides={"num_layers": 1, "embed_dim": 32, "num_heads": 2},
+    )
+    base.update(overrides)
+    return TrainConfig(**base)
+
+
+def _batch(step):
+    rng = np.random.default_rng(step)
+    return {
+        "images": rng.standard_normal((2, 4, 4, 3)).astype(np.float32),
+        "labels": rng.integers(0, 10, (2,), dtype=np.int32),
+    }
+
+
+# ----------------------------------------------------------- ring bounds
+
+
+def test_ring_eviction_and_batch_retention_bounds(tmp_path):
+    rec = FlightRecorder(str(tmp_path), depth=8, keep_batches=3, seed=0)
+    for step in range(1, 21):
+        rec.observe_batch(_batch(step))
+        rec.on_step(step)
+    entries = list(rec._ring)
+    assert [e.step for e in entries] == list(range(13, 21))  # depth bound
+    held = [e.step for e in entries if e.batch is not None]
+    assert held == [18, 19, 20]  # only the newest keep_batches hold data
+    assert not rec._pending  # every observed batch was consumed
+    assert rec.last_step == 20
+    assert rec.stats()["steps"] == 20.0
+
+
+def test_recorder_rejects_unreplayable_knobs(tmp_path):
+    with pytest.raises(ValueError):
+        FlightRecorder(str(tmp_path), depth=4, keep_batches=8)
+    with pytest.raises(ValueError):
+        # A snapshot cadence beyond the batch window could never replay.
+        FlightRecorder(
+            str(tmp_path), depth=8, keep_batches=2, snapshot_every=4
+        )
+
+
+def test_batch_fingerprint_rides_content_not_identity(tmp_path):
+    rec = FlightRecorder(str(tmp_path), depth=4, keep_batches=1, seed=0)
+    a, b = _batch(1), _batch(1)
+    same = _batch(2)
+    from sav_tpu.obs.recorder import batch_fingerprint
+
+    assert batch_fingerprint(a)["hash"] == batch_fingerprint(b)["hash"]
+    assert batch_fingerprint(a)["hash"] != batch_fingerprint(same)["hash"]
+    assert batch_fingerprint(a)["shapes"]["images"] == [2, 4, 4, 3]
+    del rec
+
+
+# -------------------------------------------------------- incident gates
+
+
+def test_spike_gate_flags_upward_spikes_only(tmp_path):
+    rec = FlightRecorder(
+        str(tmp_path), depth=4, keep_batches=1, spike_sigma=6.0, seed=0
+    )
+    # Healthy noisy window: never triggers while the gate warms up or on
+    # jitter within the MAD envelope.
+    for i, loss in enumerate(
+        [2.30, 2.28, 2.31, 2.27, 2.29, 2.30, 2.26, 2.28, 2.29, 2.27]
+    ):
+        assert rec.note_metrics(i + 1, {"loss": loss}) is None
+    # A collapse (downward) is progress, not an incident.
+    assert rec.note_metrics(11, {"loss": 0.5}) is None
+    # An upward spike beyond the robust envelope triggers.
+    assert rec.note_metrics(12, {"loss": 10.0}) == "loss_spike"
+
+
+def test_nonfinite_gate_fires_once_per_episode(tmp_path):
+    rec = FlightRecorder(str(tmp_path), depth=4, keep_batches=1, seed=0)
+    assert rec.note_metrics(1, {"loss": float("nan")}) == "nonfinite"
+    # NaN persists in the state: later windows are the same episode.
+    assert rec.note_metrics(2, {"loss": float("nan")}) is None
+    assert rec.note_metrics(3, {"loss": 2.0}) is None  # episode ends
+    assert rec.note_metrics(4, {"loss": float("inf")}) == "nonfinite"
+    # Host-only keys never drive detection (hbm stats, throughput...).
+    assert rec.note_metrics(
+        5, {"loss": 2.0, "images_per_sec": float("nan")}
+    ) is None
+
+
+def test_sparse_step_bundle_is_not_replayable(tmp_path):
+    """bench.py --record records at *window* granularity (entries at
+    steps 10, 20, ... with snapshots between): the gap steps hold no
+    batches, so the bundle must come out replayable: false — a snapshot
+    that merely overlaps the kept window is not a replay recipe."""
+    rec = FlightRecorder(
+        str(tmp_path), depth=4, keep_batches=4, snapshot_every=1, seed=0
+    )
+    for window in (1, 2, 3):
+        rec.snapshot((window - 1) * 10, {"w": np.zeros(2, np.float32)})
+        rec.observe_batch(_batch(window))
+        rec.on_step(window * 10)
+    path = rec.dump_incident("nonfinite", 30)
+    assert path is not None
+    with open(os.path.join(path, "incident.json")) as f:
+        doc = json.load(f)
+    assert doc["replayable"] is False
+    assert doc["snapshot_step"] == 20  # nearest context still recorded
+
+
+def test_dump_budget_and_dedup(tmp_path):
+    rec = FlightRecorder(
+        str(tmp_path), depth=4, keep_batches=1, max_incidents=2, seed=0
+    )
+    rec.on_step(1)
+    assert rec.dump_incident("nonfinite", 1) is not None
+    assert rec.dump_incident("nonfinite", 1) is None  # same step+trigger
+    assert rec.dump_incident("exception", 1) is not None  # distinct trigger
+    assert rec.dump_incident("nonfinite", 2) is None  # budget spent
+
+
+# ------------------------------------------- planted NaN -> replay (e2e)
+
+
+def _fit(config, *, steps, data_seed=3):
+    trainer = Trainer(config)
+    data = synthetic_data_iterator(
+        batch_size=config.global_batch_size, image_size=config.image_size,
+        num_classes=config.num_classes, seed=data_seed,
+    )
+    state, history = trainer.fit(data, num_steps=steps, log_fn=None)
+    return trainer, state, history
+
+
+def test_planted_nan_bundle_replays_bitexact_and_names_group(
+    tmp_path, devices
+):
+    """The acceptance pipeline end-to-end: scaled-up lr NaNs the run, the
+    recorder dumps a replayable bundle, and replay_step reproduces the
+    recorded metrics bit-exactly and names the first nonfinite layer
+    group (cross-checked against the recorded in-jit diagnostics)."""
+    config = _config(tmp_path, base_lr=1e12)
+    _, _, history = _fit(config, steps=8)
+    losses = [m["loss"] for m in history if "loss" in m]
+    assert any(not np.isfinite(v) for v in losses), "NaN never planted"
+
+    incidents_dir = os.path.join(str(tmp_path), "incidents")
+    bundles = sorted(os.listdir(incidents_dir))
+    assert len(bundles) == 1  # one bundle per nonfinite episode
+    bundle = os.path.join(incidents_dir, bundles[0])
+    with open(os.path.join(bundle, "incident.json")) as f:
+        doc = json.load(f)
+    assert doc["trigger"] == "nonfinite"
+    assert doc["replayable"] is True
+    assert doc["snapshot_step"] is not None
+    assert doc["batch_steps"], "no batches kept"
+    # Bundle layout: a batch npz per kept step + the state checkpoint.
+    for s in doc["batch_steps"]:
+        assert os.path.exists(os.path.join(bundle, f"batch_{s:08d}.npz"))
+    assert os.path.isdir(os.path.join(bundle, "state"))
+    # The ring index carries fingerprints and the logged metrics.
+    ring = {e["step"]: e for e in doc["ring"]}
+    bad_step = doc["step"]
+    assert ring[bad_step]["metrics"] is not None
+    assert ring[bad_step]["batch"]["hash"]
+    # Recorded batches round-trip through the npz + dtype sidecar.
+    first = doc["batch_steps"][0]
+    loaded = load_bundle_batch(
+        bundle, first, ring[first]["batch"]["dtypes"]
+    )
+    assert loaded["images"].shape == tuple(
+        ring[first]["batch"]["shapes"]["images"]
+    )
+
+    # --- replay: bit-exact + provenance ---
+    rc = replay_step.main([bundle, "--json"])
+    assert rc == 0
+    with open(os.path.join(bundle, "replay_verdict.json")) as f:
+        verdict = json.load(f)
+    assert verdict["metrics_match"] is True, verdict["mismatches"]
+    assert verdict["steps_compared"] >= 1
+    assert verdict["first_bad_step"] == bad_step
+    # Independent cross-check: the groups the replay names must be
+    # exactly the groups whose RECORDED in-jit grad norms went nonfinite.
+    recorded_bad = sorted(
+        k[len("grad_norm/"):]
+        for k, v in ring[bad_step]["metrics"].items()
+        if k.startswith("grad_norm/") and not np.isfinite(v)
+    )
+    assert sorted(verdict["bad_groups"]) == recorded_bad
+    assert verdict["first_bad_group"] in recorded_bad
+    # Escalation rung 2: checkify names the first failing primitive.
+    assert verdict["checkify"] is not None
+    assert "nan" in verdict["checkify"]["first_error"].lower()
+    # Rung 3 is skipped honestly when the run was already f32.
+    assert verdict["f32"] == {"ran": False, "reason": "already float32"}
+
+    # run_report renders the incident alongside the other sections.
+    out = io.StringIO()
+    spec = importlib.util.spec_from_file_location(
+        "run_report", os.path.join(ROOT, "tools", "run_report.py")
+    )
+    report = importlib.util.module_from_spec(spec)
+    sys.modules[spec.name] = report
+    spec.loader.exec_module(report)
+    report.report_incidents(str(tmp_path), out)
+    text = out.getvalue()
+    assert "trigger=nonfinite" in text
+    assert "bit-exact" in text
+    assert verdict["first_bad_group"] in text
+
+
+def test_healthy_run_zero_incidents_and_overhead_bound(tmp_path, devices):
+    """Spike-gate no-false-positive on a healthy seeded run of the same
+    length, and the steady-state cost contract: the recorder's
+    training-thread bookkeeping stays under 2% of step time (its hashing
+    runs on the feeder thread, reported separately — like feeder/h2d_s)."""
+    config = _config(tmp_path, base_lr=1e-3, log_every_steps=2)
+    trainer, _, history = _fit(config, steps=8)
+    assert not os.path.exists(os.path.join(str(tmp_path), "incidents"))
+    gauges = trainer.last_goodput["gauges"]
+    assert gauges["recorder/incidents"] == 0.0
+    assert gauges["recorder/steps"] == 8.0
+    step_s = trainer.last_goodput["buckets_s"]["step"]
+    assert step_s > 0
+    assert gauges["recorder/overhead_s"] < 0.02 * step_s, (
+        f"recorder overhead {gauges['recorder/overhead_s']:.6f}s is not "
+        f"<2% of step time {step_s:.6f}s"
+    )
+    # Hashing happened (on the feeder thread) and is visible as a gauge.
+    assert gauges["recorder/hash_s"] > 0.0
+
+
+# ----------------------------------------------------------- crash paths
+
+
+def test_eval_nonfinite_dumps_bundle_and_debug_nans_raises(
+    tmp_path, devices
+):
+    """Satellite: cfg.debug_nans + the recorder wired through evaluate()
+    — a nonfinite eval loss produces the same incident bundle."""
+    import jax
+    import jax.numpy as jnp
+
+    config = _config(tmp_path, debug_nans=True)
+    trainer = Trainer(config)
+    state = trainer.init_state()
+    poisoned = state.replace(
+        params=jax.tree.map(lambda x: x * jnp.float32("nan"), state.params)
+    )
+
+    def eval_iter():
+        for step in range(2):
+            yield _eval_batch(step)
+
+    def _eval_batch(step):
+        rng = np.random.default_rng(step)
+        return {
+            "images": rng.standard_normal((8, 32, 32, 3)).astype(
+                np.float32
+            ),
+            "labels": rng.integers(0, 10, (8,), dtype=np.int32),
+        }
+
+    with pytest.raises(FloatingPointError, match="eval"):
+        trainer.evaluate(poisoned, eval_iter())
+    bundles = os.listdir(os.path.join(str(tmp_path), "incidents"))
+    assert len(bundles) == 1
+    with open(
+        os.path.join(str(tmp_path), "incidents", bundles[0],
+                     "incident.json")
+    ) as f:
+        doc = json.load(f)
+    assert doc["trigger"] == "eval_nonfinite"
+    assert "eval_loss" in doc["extra"]["bad_keys"]
+
+
+def test_midfit_eval_nonfinite_dumps_exactly_one_bundle(tmp_path, devices):
+    """A nonfinite mid-fit eval under debug_nans dumps 'eval_nonfinite'
+    and then raises — fit()'s finally must recognize the failure already
+    dumped and not burn a second budget slot on a copy."""
+    config = _config(
+        tmp_path, debug_nans=True, num_train_images=8 * 2,
+        eval_every_epochs=1,
+    )
+    trainer = Trainer(config)
+    data = synthetic_data_iterator(
+        batch_size=8, image_size=32, num_classes=10, seed=3
+    )
+
+    def nan_eval_iter():
+        batch = next(
+            synthetic_data_iterator(
+                batch_size=8, image_size=32, num_classes=10, seed=5
+            )
+        )
+        batch = dict(batch)
+        batch["images"] = np.full_like(batch["images"], np.nan)
+        yield batch
+
+    with pytest.raises(FloatingPointError):
+        trainer.fit(
+            data, num_steps=4, eval_iter_fn=nan_eval_iter, log_fn=None
+        )
+    incidents_dir = os.path.join(str(tmp_path), "incidents")
+    bundles = sorted(os.listdir(incidents_dir))
+    assert len(bundles) == 1, bundles
+    with open(
+        os.path.join(incidents_dir, bundles[0], "incident.json")
+    ) as f:
+        doc = json.load(f)
+    assert doc["trigger"] == "eval_nonfinite"
+
+
+def test_exception_in_fit_dumps_incident_bundle(tmp_path, devices):
+    """An uncaught exception mid-fit still dumps whatever context the
+    ring holds (the finally path), classified as trigger 'exception'."""
+    config = _config(tmp_path)
+    trainer = Trainer(config)
+
+    def dying_iter():
+        data = synthetic_data_iterator(
+            batch_size=8, image_size=32, num_classes=10, seed=3
+        )
+        for i in range(3):
+            yield next(data)
+        raise RuntimeError("input pipeline died mid-run")
+
+    with pytest.raises(RuntimeError, match="input pipeline died"):
+        trainer.fit(dying_iter(), num_steps=16, log_fn=None)
+    incidents_dir = os.path.join(str(tmp_path), "incidents")
+    bundles = sorted(os.listdir(incidents_dir))
+    assert len(bundles) == 1
+    with open(
+        os.path.join(incidents_dir, bundles[0], "incident.json")
+    ) as f:
+        doc = json.load(f)
+    assert doc["trigger"] == "exception"
+    assert "input pipeline died" in doc["error"]
+    assert doc["ring"], "ring context lost on the crash path"
+
+
+def test_watchdog_fire_dumps_bundle_before_exit(tmp_path):
+    """Satellite order proof (like the hang-finalize one): when the
+    watchdog fires, the recorder bundle is on disk and the manifest's
+    finalize notes point at it BEFORE os._exit can discard anything."""
+    from sav_tpu.obs.manifest import RunManifest
+    from sav_tpu.obs.watchdog import WATCHDOG_EXIT_CODE, HangWatchdog
+
+    recorder = FlightRecorder(
+        str(tmp_path), depth=4, keep_batches=2, seed=0
+    )
+    recorder.observe_batch(_batch(1))
+    recorder.on_step(1)
+    manifest = RunManifest(str(tmp_path / "manifest.json"), kind="train")
+    manifest.begin()
+    observed = {}
+
+    def exit_fn(code):
+        # Order proof: everything must already be durable at exit time.
+        observed["code"] = code
+        observed["doc"] = RunManifest.load(manifest.path)
+        incidents = os.path.join(str(tmp_path), "incidents")
+        observed["bundles"] = sorted(os.listdir(incidents))
+
+    watchdog = HangWatchdog(
+        0.2, manifest=manifest, recorder=recorder, tag="rec-watchdog",
+        exit_fn=exit_fn, stream=io.StringIO(), poll_s=0.05,
+    )
+    watchdog.start()
+    try:
+        assert watchdog.fired.wait(timeout=5.0), "watchdog never fired"
+    finally:
+        watchdog.stop()
+    assert observed["code"] == WATCHDOG_EXIT_CODE
+    assert observed["bundles"], "no incident bundle at exit time"
+    doc = observed["doc"]
+    assert doc["outcome"] == "hang"
+    bundle = os.path.join(
+        str(tmp_path), "incidents", observed["bundles"][0]
+    )
+    assert doc["notes"]["incident"] == bundle
+    with open(os.path.join(bundle, "incident.json")) as f:
+        incident = json.load(f)
+    assert incident["trigger"] == "hang"
+
+
+# ------------------------------------------------------- replay plumbing
+
+
+def test_replay_rejects_unreplayable_and_missing_bundles(tmp_path):
+    assert replay_step.main([str(tmp_path / "nope")]) == 2
+    bundle = tmp_path / "incidents" / "step_00000001"
+    bundle.mkdir(parents=True)
+    (bundle / "incident.json").write_text(json.dumps({
+        "schema": 1, "step": 1, "trigger": "eval_nonfinite",
+        "ring": [], "batch_steps": [], "snapshot_step": None,
+        "replayable": False, "config": {}, "rng": {"seed": 0},
+    }))
+    assert replay_step.main([str(bundle)]) == 2

@@ -85,6 +85,12 @@ BAD_EXPECTATIONS = {
         ("SAV110", 6),  # PRNGKey(seed + 1)
         ("SAV110", 7),  # PRNGKey(2 * seed)
     ],
+    "sav111_bad.py": [
+        ("SAV111", 11),  # float(metrics) on a bare name in fit()
+        ("SAV111", 17),  # jax.device_get in the recorder's on_step()
+        ("SAV111", 20),  # metrics[...].item() in note_metrics()
+        ("SAV111", 21),  # float(metrics[...]) in note_metrics()
+    ],
 }
 
 CLEAN_FIXTURES = [
@@ -98,6 +104,7 @@ CLEAN_FIXTURES = [
     "sav_tpu/models/sav108_clean.py",
     "sav109_clean.py",
     "sav110_clean.py",
+    "sav111_clean.py",
 ]
 
 

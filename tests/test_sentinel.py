@@ -67,6 +67,25 @@ def test_infra_failures_only_exits_zero_but_lists_them():
     assert "REGRESS" not in proc.stdout
 
 
+def test_nonfinite_outcome_is_listed_but_never_scored():
+    """ISSUE 5: a diverged (NaN) run's throughput is not a measurement.
+    The nonfinite fixture's latest record carries outcome: nonfinite (a
+    bench that planted an incident bundle); the sentinel must list it as
+    an infra-style failure and score only the healthy history — exit 0."""
+    proc = _run_cli(os.path.join(FIXTURES, "nonfinite"))
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "1 infra failures" in proc.stdout
+    assert "nonfinite" in proc.stdout
+    assert "REGRESS" not in proc.stdout
+    # And the record normalizes with empty metrics (never averaged).
+    path = os.path.join(FIXTURES, "nonfinite", "BENCH_r04.json")
+    with open(path) as f:
+        record = normalize_run_record(json.load(f), label="r04")
+    assert record.outcome == "nonfinite"
+    assert not record.ok
+    assert record.metrics == {}
+
+
 def test_usage_and_io_errors_exit_two(tmp_path):
     assert _run_cli().returncode == 2  # no inputs
     assert _run_cli("/no/such/file.json").returncode == 2

@@ -17,6 +17,13 @@ healthy inputs: ``rc != 0`` / ``parsed: null`` records land in an "infra
 failures" section instead of crashing the report or being silently
 skipped (the BENCH_r05 lesson).
 
+``--incidents`` (or any log dir that has an ``incidents/`` directory)
+renders the flight recorder's bundles (``sav_tpu/obs/recorder.py``,
+docs/incident_replay.md): step, trigger, replay window, and — when
+``tools/replay_step.py`` has been run — the saved verdict (bit-exact
+reproduction, first nonfinite layer group, checkify/f32 escalation),
+so nobody has to spelunk ``.npz`` files to read an incident.
+
 Stdlib-only (no jax import): safe to run on a laptop against rsynced logs.
 
 Usage:
@@ -258,6 +265,85 @@ def report_manifest(doc: dict, out) -> None:
             f"{probe.get('deadline_s')}s deadline",
             file=out,
         )
+    incidents = notes.get("incidents") or (
+        [{"path": notes["incident"]}] if notes.get("incident") else []
+    )
+    if incidents:
+        print(
+            f"  INCIDENTS: {len(incidents)} flight-recorder bundle(s) — "
+            "see the Incidents section / tools/replay_step.py",
+            file=out,
+        )
+
+
+def report_incidents(log_dir: str, out) -> None:
+    """Render the flight recorder's incident directory + replay verdicts."""
+    root = os.path.join(log_dir, "incidents")
+    if not os.path.isdir(root):
+        print(f"(no incidents directory at {root})", file=out)
+        return
+    bundles = sorted(
+        d for d in os.listdir(root)
+        if os.path.isdir(os.path.join(root, d))
+    )
+    print(f"Incidents: {len(bundles)} bundle(s) under {root}", file=out)
+    for name in bundles:
+        bundle = os.path.join(root, name)
+        try:
+            with open(os.path.join(bundle, "incident.json")) as f:
+                doc = json.load(f)
+        except (OSError, json.JSONDecodeError):
+            print(f"  {name}: (unreadable/torn incident.json)", file=out)
+            continue
+        batches = doc.get("batch_steps") or []
+        snap = doc.get("snapshot_step")
+        print(
+            f"  step {doc.get('step')}: trigger={doc.get('trigger')} "
+            f"(snapshot {snap if snap is not None else '-'}; "
+            f"{len(batches)} batch(es) kept; "
+            f"{'replayable' if doc.get('replayable') else 'NOT replayable'})",
+            file=out,
+        )
+        if doc.get("error"):
+            print(f"    error: {str(doc['error'])[:120]}", file=out)
+        verdict_path = os.path.join(bundle, "replay_verdict.json")
+        if not os.path.exists(verdict_path):
+            if doc.get("replayable"):
+                print(
+                    f"    (no replay verdict — run: python "
+                    f"tools/replay_step.py {bundle})",
+                    file=out,
+                )
+            continue
+        try:
+            with open(verdict_path) as f:
+                verdict = json.load(f)
+        except (OSError, json.JSONDecodeError):
+            print("    (unreadable/torn replay_verdict.json)", file=out)
+            continue
+        exact = (
+            "bit-exact" if verdict.get("metrics_match")
+            else "MISMATCHED"
+        )
+        print(
+            f"    replay: {len(verdict.get('replayed_steps') or [])} "
+            f"step(s), recorded metrics {exact}; first nonfinite step "
+            f"{verdict.get('first_bad_step')}, first bad layer group "
+            f"{verdict.get('first_bad_group')}",
+            file=out,
+        )
+        checkify = verdict.get("checkify") or {}
+        if checkify.get("first_error"):
+            print(f"    checkify: {checkify['first_error'][:120]}", file=out)
+        f32 = verdict.get("f32") or {}
+        if f32.get("ran"):
+            print(
+                "    f32 recompute: "
+                + ("finite — bf16 range/precision implicated"
+                   if f32.get("finite")
+                   else "still nonfinite — genuine divergence"),
+                file=out,
+            )
 
 
 def report_bench_history(paths: list, out) -> int:
@@ -308,12 +394,25 @@ def main(argv=None) -> int:
         help="bench record files (BENCH_r*.json wrappers, raw bench JSON "
         "lines, manifests): rendered with infra failures separated",
     )
+    parser.add_argument(
+        "--incidents", action="store_true",
+        help="render the log dir's flight-recorder incident bundles "
+        "(<log-dir>/incidents/) with their replay verdicts; incident "
+        "bundles are also rendered automatically when the directory "
+        "exists",
+    )
     args = parser.parse_args(argv)
     if (
         args.log_dir is None and args.metrics is None
         and args.goodput is None and args.bench is None
     ):
         parser.error("pass a log dir, --metrics, --goodput, or --bench")
+    if args.incidents and args.log_dir is None:
+        if args.bench is None:
+            parser.error("--incidents needs a log dir to look under")
+        # --bench without a log dir: render the history, just note the
+        # flag had nothing to point at instead of aborting the report.
+        print("(--incidents ignored: no log dir given)", file=sys.stderr)
 
     if args.bench:
         rc = report_bench_history(args.bench, sys.stdout)
@@ -352,6 +451,12 @@ def main(argv=None) -> int:
                     report_manifest(json.load(f), out)
             except json.JSONDecodeError:
                 print(f"Manifest: {manifest_path} (unreadable/torn)", file=out)
+
+    if args.log_dir and (
+        args.incidents
+        or os.path.isdir(os.path.join(args.log_dir, "incidents"))
+    ):
+        report_incidents(args.log_dir, out)
 
     if args.log_dir:
         spans = os.path.join(args.log_dir, "spans.trace.json")

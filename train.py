@@ -179,6 +179,34 @@ import click
     "checkpoint gap.",
 )
 @click.option(
+    "--record/--no-record", default=False,
+    help="Flight recorder (docs/incident_replay.md): keep a bounded ring "
+    "of the last steps' host-side context (batch hashes + raw batches, "
+    "rng recipe, metrics, periodic pre-step state snapshots) and dump a "
+    "replayable incident bundle under <log-dir>/incidents/step_<N>/ on "
+    "nonfinite metrics, a loss spike, a watchdog hang, or a crash. "
+    "Steady-state cost is host-only bookkeeping; replay with "
+    "tools/replay_step.py.",
+)
+@click.option(
+    "--record-depth", type=int, default=16,
+    help="Ring-buffer depth (steps of context the recorder retains; the "
+    "newest --record-batches of them keep their raw host batches — both "
+    "clamp to the depth when it is smaller).",
+)
+@click.option(
+    "--record-batches", type=int, default=4,
+    help="Raw host batches the recorder retains (and the pre-step "
+    "snapshot cadence ceiling); replay covers at most this many steps "
+    "before the incident.",
+)
+@click.option(
+    "--spike-sigma", type=float, default=6.0,
+    help="Loss-spike incident gate: flag a logged loss more than this "
+    "many scaled MADs above the rolling median of healthy windows "
+    "(upward only; 0 disables; armed after 8 healthy windows).",
+)
+@click.option(
     "--sanitize/--no-sanitize", default=False,
     help="Runtime sanitizers around the steady-state hot loop "
     "(sav_tpu.analysis.sanitize): disallow implicit host->device "
@@ -282,6 +310,7 @@ def _run(
     eval_only, steps, num_train_images,
     num_eval_images, crop_min_area, train_flip, platform, backend_wait,
     fused_optimizer, log_dir, diagnostics, trace_spans, watchdog_secs,
+    record, record_depth, record_batches, spike_sigma,
     sanitize, device_preprocess, async_feed, feed_depth,
     compilation_cache_dir, peak_flops, seed,
 ):
@@ -390,6 +419,10 @@ def _run(
         diagnostics=diagnostics,
         trace_spans=trace_spans,
         watchdog_secs=watchdog_secs,
+        record=record,
+        record_depth=record_depth,
+        record_batches=record_batches,
+        spike_sigma=spike_sigma,
         sanitize=sanitize,
         seed=seed,
         **(
@@ -420,6 +453,9 @@ def _run(
             "peak_flops": "peak_flops",
             "log_dir": "log_dir", "diagnostics": "diagnostics",
             "trace_spans": "trace_spans", "watchdog_secs": "watchdog_secs",
+            "record": "record", "record_depth": "record_depth",
+            "record_batches": "record_batches",
+            "spike_sigma": "spike_sigma",
             "sanitize": "sanitize",
         }
         overrides = {

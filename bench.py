@@ -138,10 +138,14 @@ def _record_window(recorder, step, loss_val, result):
 
 def run(model_name, batch_size, steps, backend, image_size, reps, feed,
         device_preprocess=False, async_feed=True, compilation_cache_dir=None,
-        peak_flops=None, record=False, record_dir=None):
+        peak_flops=None, record=False, record_dir=None, attn_tune_cache=None):
     import jax
 
     from sav_tpu.data import synthetic_data_iterator
+    from sav_tpu.ops.attention import (
+        clear_dispatch_log,
+        snapshot_dispatch_log,
+    )
     from sav_tpu.obs.costs import (
         publish_cost_gauges,
         resolve_peak_flops,
@@ -156,6 +160,18 @@ def run(model_name, batch_size, steps, backend, image_size, reps, feed,
         from sav_tpu.utils.compile_cache import enable_persistent_cache
 
         enable_persistent_cache(compilation_cache_dir)
+    if attn_tune_cache:
+        # Point the 'auto' dispatcher at a measured shape→config table
+        # (tools/attn_tune.py output) instead of the checked-in default.
+        from sav_tpu.ops.attn_tuning import set_cache_path
+
+        set_cache_path(attn_tune_cache)
+    # Attention-dispatch provenance: the resolver logs every traced
+    # attention shape's (backend, block config, reason) at trace time;
+    # cleared here so the stamped record covers exactly this bench's
+    # compile (A/B runs and the sentinel can then attribute a number to
+    # the dispatch decision that produced it).
+    clear_dispatch_log()
 
     # Wall-time ledger over the whole measurement (docs/observability.md):
     # compile vs step vs input-wait decomposition plus per-window stall
@@ -375,6 +391,11 @@ def run(model_name, batch_size, steps, backend, image_size, reps, feed,
             result["peak_bound_img_per_sec_per_chip"] = round(
                 peak * per_chip_images / cost.flops, 1
             )
+    # The resolved attention dispatch (backend + block config per traced
+    # shape) — stamped into the JSON line and the run manifest so perf
+    # history is attributable to the dispatch decision, not just the
+    # requested flag (tools/regression_sentinel.py reads the manifests).
+    result["attention_dispatch"] = snapshot_dispatch_log()
     result.update(
         best_step_ms=round(best * 1e3, 2),
         median_img_per_sec_per_chip=round(
@@ -432,10 +453,12 @@ def main(argv=None):
     parser.add_argument(
         "--backend",
         default="xla",
-        choices=["xla", "pallas", "auto"],
-        help="attention backend (measured crossover: XLA wins at ≤~800-token "
-        "DeiT/CaiT shapes, the fused kernels win on memory at long L — "
-        "see PERF.md)",
+        choices=["xla", "fused", "pallas", "auto"],
+        help="attention backend: xla (dense), fused (single-pass "
+        "short-sequence kernel), pallas (online-softmax flash), or the "
+        "three-way measured auto dispatch (short band consults the "
+        "attn_tune cache; long band is flash — see PERF.md). The resolved "
+        "decision is stamped into the JSON line as attention_dispatch",
     )
     parser.add_argument(
         "--feed",
@@ -477,6 +500,12 @@ def main(argv=None):
         help="per-chip peak FLOP/s override for MFU/roofline accounting "
         "(docs/perf_accounting.md); default: the device-kind table, with "
         "a deterministic fake peak on CPU (labeled cpu-fake)",
+    )
+    parser.add_argument(
+        "--attn-tune-cache", default=None,
+        help="tools/attn_tune.py shape→config cache for the 'auto' "
+        "dispatcher (default: SAV_ATTN_TUNE_CACHE env var, then the "
+        "checked-in sav_tpu/ops/attn_tune_cache.json)",
     )
     parser.add_argument(
         "--record", action="store_true",
@@ -532,6 +561,7 @@ def main(argv=None):
             peak_flops=args.peak_flops,
             record=args.record,
             record_dir=os.path.dirname(args.manifest) or "runs/bench",
+            attn_tune_cache=args.attn_tune_cache,
         )
     except BaseException as e:
         # Every exit path stays parseable: classify (oom/error/...), put
@@ -581,6 +611,8 @@ def main(argv=None):
     }
     out.update(extra)
     notes = {"metric": out["metric"], "platform": out["platform"]}
+    if extra.get("attention_dispatch"):
+        notes["attention_dispatch"] = extra["attention_dispatch"]
     if extra.get("incident"):
         notes["incident"] = extra["incident"]
     manifest.finalize(

@@ -3,9 +3,11 @@
 Capability parity with the reference's attention family
 (/root/reference/models/layers/attentions/attention.py:10-74,
 talking_heads.py:5-14), redesigned around the backend-dispatched functional
-cores in :mod:`sav_tpu.ops.attention` so every block can run on the fused
-Pallas TPU kernel (``backend='pallas'``) or the XLA reference path
-(``backend='xla'``). Talking-heads mixing couples heads, so it gets its own
+cores in :mod:`sav_tpu.ops.attention` so every block can run on the
+single-pass fused short-sequence kernel (``backend='fused'``), the
+blockwise flash kernel (``backend='pallas'``) or the XLA reference path
+(``backend='xla'``) — ``'auto'`` resolves per shape from the measured
+attn_tune cache. Talking-heads mixing couples heads, so it gets its own
 fused kernel that keeps all heads of a batch element in one grid cell
 (:mod:`sav_tpu.ops.talking_heads` — CaiT's self-attention trunk); the XLA
 path remains the numerics reference and the long-sequence/dropout fallback.
@@ -157,7 +159,11 @@ class AttentionBlock(nn.Module):
     # RoPE on Q/K after projection (the working rebuild of the reference's
     # broken, never-wired rotary path — SURVEY.md §2.9 #12).
     use_rotary: bool = False
-    backend: Optional[str] = None  # None/'auto' | 'xla' | 'pallas'
+    # Attention-core backend: None/'auto' = measured three-way dispatch
+    # (sav_tpu.ops.attention.resolve_attention_backend — fused-short /
+    # xla / flash by shape band + the attn_tune cache), or force 'xla' |
+    # 'fused' | 'pallas'.
+    backend: Optional[str] = None
     logits_dtype: Optional[Dtype] = None  # None = inherit dtype (softmax math)
     # Sequence parallelism: route the attention core through
     # sav_tpu.parallel.seq_parallel over ``seq_mesh``'s 'seq' axis
@@ -243,11 +249,11 @@ class AttentionBlock(nn.Module):
                     "seq_parallel set but no seq_mesh given; pass the "
                     "training Mesh (with a 'seq' axis) to the block"
                 )
-            if self.backend == "pallas":
+            if self.backend in ("pallas", "fused"):
                 raise ValueError(
                     "seq_parallel runs the dense XLA core per shard; "
-                    "backend='pallas' is not routed under SP (the bare "
-                    "ring_attention/ulysses_attention ops expose flash "
+                    f"backend={self.backend!r} is not routed under SP (the "
+                    "bare ring_attention/ulysses_attention ops expose flash "
                     "mode for divisible lengths) — unset one of the two"
                 )
             # logits_dtype does not apply here: online-softmax statistics
@@ -291,7 +297,10 @@ class AttentionBlock(nn.Module):
                 and query.ndim == 4
                 and fused_eligible(self.num_heads, key.shape[1], head_ch)
             )
-            if backend == "pallas":
+            if backend in ("pallas", "fused"):
+                # Head mixing couples heads, so both kernel backends mean
+                # the same thing here: the dedicated talking-heads kernel
+                # (itself single-KV-block fused).
                 if has_attn_dropout:
                     raise ValueError(
                         "pallas talking-heads attention is deterministic-only "

@@ -168,8 +168,8 @@ def create_model(
       model_name: a key from :func:`model_names`.
       num_classes: classifier width.
       dtype: compute dtype (params stay fp32).
-      backend: attention backend ('xla' | 'pallas' | None=auto) threaded to
-        every attention block.
+      backend: attention backend ('xla' | 'fused' | 'pallas' | None=auto —
+        the measured three-way dispatch) threaded to every attention block.
       logits_dtype: softmax dtype for the XLA attention path, threaded to
         every attention block (None = inherit ``dtype``, the reference's
         semantics; 'float32' forces f32 softmax under bf16 compute).

@@ -16,30 +16,49 @@ reference. The Pallas path transposes to ``[B*H, L, D]`` internally.
                    available as :func:`xla_attention_fast` for
                    memory-constrained cases (bf16 residual halves the saved
                    probabilities' HBM footprint).
-  - ``'pallas'`` — fused Pallas TPU flash-attention kernel
-                   (:mod:`sav_tpu.ops.flash_attention`). Deterministic only
-                   (attention dropout falls back to XLA).
-  - ``'auto'``   — measured-crossover dispatch on TPU (else xla). Benchmarked
-                   on v5e (PERF.md): at the model zoo's short sequences
-                   (197–785 tokens) XLA's batched-matmul attention beats
-                   every flash kernel — including the tuned stock one — by
-                   ~2×, because the L² logits easily fit HBM and the MXU
-                   stays busy; the fused kernel's win is *memory*: it keeps
-                   O(L²) out of HBM entirely, which is what long-context /
-                   ring-attention shapes need. ``auto`` therefore picks
-                   pallas only when the dense fp32 logits would be
-                   HBM-prohibitive and xla otherwise.
+  - ``'fused'``  — single-pass fused short-sequence kernel
+                   (:mod:`sav_tpu.ops.fused_attention`): the whole KV
+                   sequence in one VMEM block, plain softmax (no online
+                   carry), single fused backward. Raises when the shape
+                   exceeds the single-block VMEM budget. Deterministic only.
+  - ``'pallas'`` — blockwise online-softmax flash kernel
+                   (:mod:`sav_tpu.ops.flash_attention`) for shapes beyond
+                   the single block. Deterministic only (attention dropout
+                   falls back to XLA).
+  - ``'auto'``   — three-way measured dispatch on TPU (else xla), resolved
+                   per traced shape by :func:`resolve_attention_backend`:
+
+                   * dense fp32 logits past the HBM budget → ``pallas``
+                     (the flash kernel's O(L·D) memory is the only way the
+                     shape runs at all);
+                   * short band (KV fits one VMEM block,
+                     ``fused_attention.fused_eligible``) → the measured
+                     winner from the ``tools/attn_tune.py`` cache
+                     (:mod:`sav_tpu.ops.attn_tuning`) — ``fused`` only
+                     where a sweep + ``ab_step`` gate confirmed the win on
+                     chip, else XLA (the PERF.md §5 measured winner);
+                   * middle band → ``xla`` (L² fits HBM comfortably and
+                     XLA keeps the MXU busy).
+
+                   Every resolution is recorded in a trace-time dispatch
+                   log (:func:`snapshot_dispatch_log`) that ``bench.py``
+                   stamps into its JSON line and run manifest, so perf
+                   history is attributable to the dispatch decision.
 """
 
 from __future__ import annotations
 
+import dataclasses
 import functools
+import threading
 from typing import Optional
 
 import jax
 import jax.numpy as jnp
 
+from sav_tpu.ops import attn_tuning
 from sav_tpu.ops import flash_attention as _flash
+from sav_tpu.ops import fused_attention as _fused
 
 
 def _on_tpu() -> bool:
@@ -49,7 +68,7 @@ def _on_tpu() -> bool:
         return False
 
 
-# 'auto' flips to the fused kernel when materializing the [B, H, Lq, Lk]
+# 'auto' flips to the flash kernel when materializing the [B, H, Lq, Lk]
 # fp32 logits (fwd + bwd residual ≈ 3 copies) would eat this much HBM —
 # beyond it the XLA path thrashes or OOMs while flash stays O(L·D).
 _AUTO_PALLAS_LOGITS_BYTES = 2 << 30
@@ -78,9 +97,11 @@ def set_default_logits_dtype(dtype) -> None:
     _DEFAULT_LOGITS_DTYPE = jnp.dtype(dtype).type
 
 
-def _dense_logits_bytes(query, key) -> int:
-    b, lq, h, _ = query.shape
-    return 3 * 4 * b * h * lq * key.shape[1]
+def _dense_logits_bytes(batch: int, heads: int, q_len: int, kv_len: int) -> int:
+    """HBM bytes of the dense attention's fp32 [B, H, Lq, Lk] working set
+    (logits + probabilities + saved bwd residual ≈ 3 copies) — the single
+    source of the ``auto`` rule's long-band accounting."""
+    return 3 * 4 * batch * heads * q_len * kv_len
 
 
 def xla_attention(
@@ -231,6 +252,138 @@ def xla_attention_fast(
     return _fast_attention(query, key, value, bias, scale)
 
 
+@dataclasses.dataclass(frozen=True)
+class AttentionDispatch:
+    """One resolved dispatch decision (static-shape, trace-time)."""
+
+    backend: str  # 'xla' | 'fused' | 'pallas'
+    reason: str  # human-readable why
+    source: str  # 'requested' | 'threshold' | 'tuned' | 'default'
+    block_config: Optional[dict] = None  # kernel block kwargs, if any
+
+    def as_note(self) -> dict:
+        return dataclasses.asdict(self)
+
+
+# Trace-time dispatch provenance, keyed by (shape, requested backend) so
+# bench.py / fit() can stamp *which* backend + block config each traced
+# attention shape resolved to. Host-side and append-once-per-trace — the
+# jitted hot path never touches it (savlint-clean by construction).
+_DISPATCH_LOG: dict = {}
+_DISPATCH_LOCK = threading.Lock()
+
+
+def clear_dispatch_log() -> None:
+    with _DISPATCH_LOCK:
+        _DISPATCH_LOG.clear()
+
+
+def snapshot_dispatch_log() -> list:
+    """Resolved decisions since the last clear, one dict per unique
+    (shape, requested) pair — the provenance record bench.py stamps into
+    its JSON line and run manifest."""
+    with _DISPATCH_LOCK:
+        return [dict(v) for v in _DISPATCH_LOG.values()]
+
+
+def _log_dispatch(shape, kv_len, requested, dispatch: AttentionDispatch) -> None:
+    # kv_len is part of the identity: cross-attention sites share a query
+    # shape with self-attention ones but can resolve differently.
+    key = (shape, kv_len, requested)
+    with _DISPATCH_LOCK:
+        if key not in _DISPATCH_LOG:
+            _DISPATCH_LOG[key] = {
+                "shape": list(shape),
+                "kv_len": kv_len,
+                "requested": requested or "auto",
+                **dispatch.as_note(),
+            }
+
+
+def resolve_attention_backend(
+    batch: int,
+    q_len: int,
+    kv_len: int,
+    heads: int,
+    dim: int,
+    *,
+    dtype="bfloat16",
+    requested: Optional[str] = None,
+    kernels_ok: bool = True,
+    on_tpu: Optional[bool] = None,
+) -> AttentionDispatch:
+    """The three-way ``auto`` rule on static shapes (see module docstring).
+
+    ``kernels_ok`` is the caller's eligibility for the Pallas paths (4-D
+    inputs, deterministic); ``on_tpu`` defaults to the live backend. Every
+    threshold here is test-pinned (tests/test_attn_dispatch.py). Explicit
+    ``requested`` backends pass through, picking up any tuned block config
+    for the shape.
+    """
+    if on_tpu is None:
+        on_tpu = _on_tpu()
+    entry = attn_tuning.lookup(batch, q_len, kv_len, heads, dim, dtype)
+    tuned_cfg = attn_tuning.block_config(entry)
+    if requested and requested != "auto":
+        cfg = tuned_cfg if (entry and entry["backend"] == requested) else None
+        return AttentionDispatch(
+            backend=requested, reason="explicit backend", source="requested",
+            block_config=cfg,
+        )
+    if not kernels_ok or not on_tpu:
+        return AttentionDispatch(
+            backend="xla",
+            reason=(
+                "kernel-ineligible call (dropout or non-4-D inputs)"
+                if not kernels_ok
+                else "non-TPU backend"
+            ),
+            source="threshold",
+        )
+    itemsize = jnp.dtype(dtype).itemsize
+    dense_bytes = _dense_logits_bytes(batch, heads, q_len, kv_len)
+    if dense_bytes > _AUTO_PALLAS_LOGITS_BYTES:
+        cfg = tuned_cfg if (entry and entry["backend"] == "pallas") else None
+        return AttentionDispatch(
+            backend="pallas",
+            reason=(
+                f"dense fp32 logits ≈{dense_bytes >> 20} MiB exceed the "
+                f"{_AUTO_PALLAS_LOGITS_BYTES >> 30} GiB HBM budget"
+            ),
+            source="threshold",
+            block_config=cfg,
+        )
+    short = _fused.fused_eligible(q_len, kv_len, dim, itemsize=itemsize)
+    if entry:
+        # A measured winner from the tune cache. Fused is additionally
+        # gated on the VMEM band (a fused verdict at an over-budget shape
+        # is stale/foreign — ignore it); xla and pallas verdicts apply at
+        # any shape the sweep measured.
+        winner = entry["backend"]
+        if winner == "fused" and not short:
+            winner = None
+        if winner:
+            return AttentionDispatch(
+                backend=winner,
+                reason=(
+                    f"measured {winner} win "
+                    f"({entry.get('source', 'tune cache')})"
+                ),
+                source="tuned",
+                block_config=tuned_cfg if winner != "xla" else None,
+            )
+    return AttentionDispatch(
+        backend="xla",
+        reason=(
+            "short band, no measured fused win yet (promotion is gated on "
+            "the attn_tune + ab_step battery)"
+            if short
+            else "middle band: dense logits fit HBM, XLA keeps the MXU busy"
+        ),
+        source="default",
+    )
+
+
 def dot_product_attention(
     query: jax.Array,
     key: jax.Array,
@@ -248,32 +401,45 @@ def dot_product_attention(
 
     ``logits_dtype`` sets the XLA path's softmax dtype (None = the
     deprecated process-wide default, f32 unless configured). The Pallas
-    flash kernel always accumulates its running softmax in f32 on-chip and
-    ignores it.
+    kernels always accumulate their softmax in f32 on-chip and ignore it.
     """
+    requested = backend
     backend = backend or "auto"
-    if backend not in ("auto", "xla", "pallas"):
+    if backend not in ("auto", "xla", "pallas", "fused"):
         raise ValueError(f"unknown attention backend: {backend!r}")
 
     has_dropout = dropout_rate > 0.0 and not deterministic
-    pallas_ok = (
+    kernels_ok = (
         not has_dropout
-        and query.ndim == 4  # [B, L, H, D] — flash path handles the common case
+        and query.ndim == 4  # [B, L, H, D] — the kernels' one layout
         and key.ndim == 4
         and (bias is None or bias.ndim == 4)
     )
-    if backend == "auto":
-        big = pallas_ok and (
-            _dense_logits_bytes(query, key) > _AUTO_PALLAS_LOGITS_BYTES
+    if kernels_ok:
+        b, lq, h, d = query.shape
+        dispatch = resolve_attention_backend(
+            b, lq, key.shape[1], h, d,
+            dtype=query.dtype, requested=requested, kernels_ok=True,
         )
-        backend = "pallas" if (big and _on_tpu()) else "xla"
-    if backend == "pallas":
-        if not pallas_ok:
+        _log_dispatch(tuple(query.shape), key.shape[1], requested, dispatch)
+        backend = dispatch.backend
+        cfg = dispatch.block_config or {}
+    else:
+        if backend in ("pallas", "fused"):
             raise ValueError(
-                "pallas attention backend requires 4-D [B, L, H, D] inputs and "
-                "deterministic mode (attention dropout runs on the XLA path)"
+                f"{backend} attention backend requires 4-D [B, L, H, D] "
+                "inputs and deterministic mode (attention dropout runs on "
+                "the XLA path)"
             )
-        return _flash.flash_attention(query, key, value, bias, scale=scale)
+        backend, cfg = "xla", {}
+    if backend == "fused":
+        # Shape ineligibility (kv_len over the single-block VMEM budget)
+        # raises inside fused_attention with the budget numbers.
+        kw = {k: cfg[k] for k in ("block_q", "block_b") if k in cfg}
+        return _fused.fused_attention(query, key, value, bias, scale=scale, **kw)
+    if backend == "pallas":
+        kw = {k: cfg[k] for k in ("block_q", "block_kv") if k in cfg}
+        return _flash.flash_attention(query, key, value, bias, scale=scale, **kw)
     return xla_attention(
         query,
         key,

@@ -541,6 +541,15 @@ def _flash_bwd(scale, block_q, block_kv, interpret, residuals, g):
         )
         return dq, dk, dv, None
     del block_q, block_kv, interpret
+    return _dense_recompute_bwd(q, k, v, bias, g, scale)
+
+
+def _dense_recompute_bwd(q, k, v, bias, g, scale):
+    """XLA flash-style recompute backward for the biased path — shared by
+    this kernel and the fused short-sequence kernel
+    (:mod:`sav_tpu.ops.fused_attention`): a dense dbias is O(L²) by
+    construction, so the recompute materializes nothing the caller's bias
+    gradient doesn't already require."""
     mm_dtype = q.dtype
     s = jnp.einsum("bqhd,bkhd->bhqk", q, k, preferred_element_type=jnp.float32) * scale
     if bias is not None:
@@ -598,7 +607,7 @@ def flash_attention(
         ``[B, heads, q_len, kv_len]`` (e.g. BoTNet relative-position logits).
       scale: logit scale, default ``head_dim ** -0.5``.
       block_q / block_kv: VMEM tile sizes (clamped for short sequences).
-        Default 256: the v5e block sweep (tools/flash_sweep.py, PERF.md §5)
+        Default 256: the v5e block sweep (now tools/attn_tune.py, PERF.md §5)
         measured 256/256 ~1.6x faster than 128/128 at model-zoo shapes.
       interpret: force Pallas interpreter mode; default = auto (on for non-TPU).
 

@@ -22,7 +22,17 @@ class TrainConfig:
     num_classes: int = 1000
     image_size: int = 224
     compute_dtype: str = "bfloat16"
-    attention_backend: Optional[str] = None  # None=auto | 'xla' | 'pallas'
+    # None=auto (three-way measured dispatch: fused-short / xla / flash by
+    # shape band + the attn_tune cache — see sav_tpu/ops/attention.py) |
+    # 'xla' | 'fused' | 'pallas'.
+    attention_backend: Optional[str] = None
+    # Path to a tools/attn_tune.py shape→config cache consulted by the
+    # 'auto' dispatcher (block configs + measured backend winners per
+    # attention shape). None = the SAV_ATTN_TUNE_CACHE env var, then the
+    # checked-in default table (sav_tpu/ops/attn_tune_cache.json — the
+    # PERF.md §5 measurements). Applied process-wide at Trainer
+    # construction (trace-time state only; no jitted path reads it).
+    attention_tune_cache: Optional[str] = None
     # Softmax dtype on the XLA attention path. None = inherit compute_dtype
     # (the reference's semantics: its logits einsum runs in the model
     # dtype). Under bf16 compute this halves the dominant [B,H,L,L] HBM

@@ -84,6 +84,13 @@ class Trainer:
             from sav_tpu.utils.compile_cache import enable_persistent_cache
 
             enable_persistent_cache(config.compilation_cache_dir)
+        if config.attention_tune_cache:
+            # Trace-time-only process state: the 'auto' dispatcher reads
+            # the shape→config table while tracing (sav_tpu/ops/
+            # attn_tuning.py); no jitted path ever consults it.
+            from sav_tpu.ops.attn_tuning import set_cache_path
+
+            set_cache_path(config.attention_tune_cache)
         self.mesh = mesh if mesh is not None else create_mesh(config.mesh_axes)
         self.compute_dtype = (
             jnp.bfloat16 if config.compute_dtype == "bfloat16" else jnp.float32
@@ -1341,6 +1348,15 @@ class Trainer:
             )
             if manifest is not None:
                 manifest.set_metrics(ledger.flat_metrics())
+                # Attention-dispatch provenance: which backend + block
+                # config every traced attention shape resolved to (filled
+                # at trace time, so it exists once the step compiled —
+                # including on crash paths after the first trace).
+                from sav_tpu.ops.attention import snapshot_dispatch_log
+
+                dispatch = snapshot_dispatch_log()
+                if dispatch:
+                    manifest.note("attention_dispatch", dispatch)
             tracer.write()
         self.last_goodput = ledger.summary()
         if obs_dir is not None and obs_writer:

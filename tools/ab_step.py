@@ -19,6 +19,16 @@ Variants (any comma list via --variants):
                relayouts beat explicit one-shot transposes.
   noclip     — clip_grad_norm=None: prices the global-norm pass in the
                'optimizer + rest' bucket (PERF.md §5's trace: ~8 ms).
+  fused      — attention_backend='fused': the single-pass short-sequence
+               kernel (sav_tpu/ops/fused_attention.py) on every attention
+               core. THE r6 promotion gate: 'auto' adopts the fused
+               kernel at a shape only when this full-step A/B plus the
+               regression sentinel confirm the win the attn_tune
+               microbench claims. Compare against the bf16logits row
+               (the shipping config), not base.
+  flash      — attention_backend='pallas': the online-softmax flash
+               kernel, same comparison (its measured loss at model-zoo
+               shapes is the reason the fused kernel exists — PERF.md §5).
 
 Prints one line per variant: best/median step ms over N windows. Chip
 throughput drifts minute-to-minute (~2x, PERF.md §5) — re-run and compare
@@ -75,7 +85,7 @@ def main():
     import jax.numpy as jnp
 
     known = {"base", "fastvjp", "bf16logits", "nofuse", "nomax", "bhld",
-             "noclip"}
+             "noclip", "fused", "flash"}
     variants = args.variants.split(",")
     unknown = set(variants) - known
     if unknown:
@@ -144,17 +154,22 @@ def main():
             num_classes=1000,
             image_size=224,
             compute_dtype="bfloat16",
-            attention_backend="xla",
+            attention_backend=(
+                {"fused": "fused", "flash": "pallas"}.get(variant, "xla")
+            ),
             # 'float32' explicitly for base/fastvjp/nofuse: None inherits
             # the compute dtype (bf16), which would collapse base and
-            # bf16logits into the same configuration. The round-4 variants
-            # (nomax/bhld/noclip) ride bf16 logits so their deltas read
-            # against the SHIPPING config — compare them to the bf16logits
-            # row, not base. Threads through create_model into the blocks'
-            # logits_dtype attribute.
+            # bf16logits into the same configuration. The round-4+ variants
+            # (nomax/bhld/noclip/fused/flash) ride bf16 logits so their
+            # deltas read against the SHIPPING config — compare them to the
+            # bf16logits row, not base. (The Pallas kernels do their
+            # softmax in f32 on-chip and ignore the knob; setting it keeps
+            # the rest of the step identical across those rows.) Threads
+            # through create_model into the blocks' logits_dtype attribute.
             attention_logits_dtype=(
                 "bfloat16"
-                if variant in ("bf16logits", "nomax", "bhld", "noclip")
+                if variant in ("bf16logits", "nomax", "bhld", "noclip",
+                               "fused", "flash")
                 else "float32"
             ),
             global_batch_size=args.batch_size,

@@ -1,0 +1,60 @@
+#!/bin/bash
+# Parameterized on-TPU battery: poll the relay, then run the steps listed in
+# a step-manifest file. Consolidates the per-round on_tpu_return_r{3b,4,5,5b}
+# scripts (retired) — the queue is DATA now, one .steps file per round.
+#
+# Usage: tools/on_tpu_battery.sh [steps-file]     (default tools/battery/r6.steps)
+#
+# Step-file format, one step per line (see tools/battery/r6.steps):
+#   NAME|TIMEOUT_S|COMMAND...
+# '#' lines and blank lines are skipped. Commands run from the repo root via
+# bash -c with PYTHONPATH=/root/repo:/root/.axon_site; stdout+stderr land in
+# .tpu_results/<NAME>.out and start/stop lines in .tpu_results/<tag>_log.
+#
+# Operational lessons baked in (PERF.md §12):
+#   - the probe is timeout-guarded and CPU-fallback-aware (a wedged relay
+#     makes backend init hang rather than error);
+#   - steps get generous `timeout` budgets and the CLIs' own --backend-wait
+#     aborts cleanly (exit 3) on a dead relay — never SIGKILL a client
+#     mid-grant as a "recovery": a killed grant-holder wedges the relay for
+#     every later process (the 9+ h lockout of round 5).
+set -u
+cd /root/repo
+STEPS=${1:-tools/battery/r6.steps}
+if [ ! -f "$STEPS" ]; then
+  echo "on_tpu_battery: no such steps file: $STEPS" >&2
+  exit 2
+fi
+TAG=$(basename "$STEPS" .steps)
+mkdir -p .tpu_results .ckpt
+LOG=".tpu_results/${TAG}_log"
+export PYTHONPATH=/root/repo:/root/.axon_site
+
+probe() {
+  timeout 90 python -u -c "
+import jax, jax.numpy as jnp
+assert jax.devices()[0].platform != 'cpu', jax.devices()
+print(jax.device_get((jnp.ones((256,256),jnp.bfloat16)@jnp.ones((256,256),jnp.bfloat16)).sum()))
+" >/dev/null 2>&1
+}
+
+echo "$(date) $TAG: polling for TPU relay" > "$LOG"
+until probe; do
+  sleep 180
+done
+echo "$(date) TPU is back — running $TAG battery" >> "$LOG"
+
+run() {  # run <name> <timeout_s> <cmd>
+  local name=$1 t=$2 cmd=$3
+  echo "$(date) START $name" >> "$LOG"
+  timeout "$t" bash -c "$cmd" > ".tpu_results/$name.out" 2>&1
+  local rc=$?
+  echo "$(date) DONE $name (rc=$rc)" >> "$LOG"
+}
+
+while IFS='|' read -r name t cmd; do
+  case "$name" in ''|'#'*) continue ;; esac
+  run "$name" "$t" "$cmd"
+done < "$STEPS"
+
+echo "$(date) $TAG battery complete" >> "$LOG"

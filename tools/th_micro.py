@@ -2,7 +2,7 @@
 """Talking-heads attention: fused kernel vs dense XLA, fwd and fwd+bwd.
 
 CaiT-shape microbenchmark with the same anti-hoisting/interleaving
-methodology as tools/attn_micro.py. Informs whether the layer's 'auto'
+methodology as tools/attn_tune.py. Informs whether the layer's 'auto'
 dispatch should prefer the fused kernel for speed or only for memory.
 """
 

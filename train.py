@@ -50,7 +50,20 @@ import click
     help="Override the model's patch size (e.g. 4 for 32x32 inputs so the "
     "token grid stays meaningful at small resolutions).",
 )
-@click.option("--backend", type=click.Choice(["auto", "xla", "pallas"]), default="auto")
+@click.option(
+    "--backend",
+    type=click.Choice(["auto", "xla", "fused", "pallas"]),
+    default="auto",
+    help="Attention backend: auto = the three-way measured dispatch "
+    "(docs/benchmarking.md decision table), or force xla / fused "
+    "(single-pass short-sequence kernel) / pallas (flash).",
+)
+@click.option(
+    "--attn-tune-cache", type=str, default=None,
+    help="tools/attn_tune.py shape->config cache consulted by the 'auto' "
+    "attention dispatch (default: SAV_ATTN_TUNE_CACHE env var, then the "
+    "checked-in sav_tpu/ops/attn_tune_cache.json).",
+)
 @click.option(
     "--logits-dtype", type=click.Choice(["inherit", "float32", "bfloat16"]),
     default="inherit",
@@ -304,7 +317,7 @@ def _run(
     batch_size,
     num_epochs, warmup_epochs, learning_rate, weight_decay, label_smoothing,
     ema_decay, clip_grad, grad_accum, augmentation, patch_size, backend,
-    logits_dtype,
+    attn_tune_cache, logits_dtype,
     remat, dtype, tp, fsdp, sp, sp_method, pp, pp_microbatches, preset,
     checkpoint_dir, init_from,
     eval_only, steps, num_train_images,
@@ -390,6 +403,7 @@ def _run(
         image_size=image_size,
         compute_dtype=dtype,
         attention_backend=None if backend == "auto" else backend,
+        attention_tune_cache=attn_tune_cache,
         attention_logits_dtype=(
             None if logits_dtype == "inherit" else logits_dtype
         ),
@@ -450,6 +464,7 @@ def _run(
             "device_preprocess": "device_preprocess",
             "async_feed": "async_feed", "feed_depth": "feed_depth",
             "compilation_cache_dir": "compilation_cache_dir",
+            "attn_tune_cache": "attention_tune_cache",
             "peak_flops": "peak_flops",
             "log_dir": "log_dir", "diagnostics": "diagnostics",
             "trace_spans": "trace_spans", "watchdog_secs": "watchdog_secs",

@@ -420,6 +420,7 @@ def _abort_backend_unreachable(args, manifest, probe_log):
     ``"parsed": null``. The stderr message and exit 3 keep the
     backend_probe abort contract wrapper scripts key on.
     """
+    from sav_tpu.obs.fleet import write_probe_timeline
     from sav_tpu.utils.backend_probe import unreachable_message
 
     message = unreachable_message("bench", args.backend_wait)
@@ -432,6 +433,15 @@ def _abort_backend_unreachable(args, manifest, probe_log):
         "backend_unreachable", error=message, exit_code=3,
         notes={"backend_probe": probe},
     )
+    # The same timeline in the fleet artifact layout (stdlib-only write,
+    # never raises): a post-mortem then distinguishes "backend never
+    # came up" (probe lines, no proc_*.jsonl heartbeats) from "backend
+    # died mid-run" (heartbeats that stop) in ONE directory —
+    # docs/fleet.md.
+    probe_path = write_probe_timeline(
+        os.path.dirname(manifest.path) or ".", probe_log,
+        deadline_s=args.backend_wait, tag="bench",
+    )
     print(message, file=sys.stderr)
     print(json.dumps({
         "metric": f"{args.model} train img/s/chip (bs={args.batch_size})",
@@ -439,6 +449,7 @@ def _abort_backend_unreachable(args, manifest, probe_log):
         "unit": "img/s/chip",
         "outcome": "backend_unreachable",
         "backend_probe": probe,
+        "probe_timeline": probe_path,
         "manifest": manifest.path,
     }))
     return 3

@@ -802,6 +802,72 @@ class AdhocSeedDerivation(Rule):
 # ---------------------------------------------------------------- SAV111
 
 
+def _metric_rooted(node) -> bool:
+    """True when the expression is rooted at a metrics-named value."""
+    while isinstance(node, (ast.Attribute, ast.Subscript)):
+        node = node.value
+    return isinstance(node, ast.Name) and "metric" in node.id.lower()
+
+
+def _metrics_sync_findings(rule, module, fn, *, where: str, coda: str):
+    """Sync detection shared by the recorder (SAV111) and fleet (SAV112)
+    hot-path rules: explicit sync calls/methods, and ``float()``/
+    ``int()`` pulling a metrics-named value (bare or rooted) to host
+    through ``__float__``. One definition so a new sync API or a
+    heuristic fix lands in both rules at once."""
+    for node in _walk_excluding_nested(fn):
+        if not isinstance(node, ast.Call):
+            continue
+        if (
+            isinstance(node.func, ast.Name)
+            and node.func.id in ("float", "int")
+            and len(node.args) == 1
+        ):
+            arg = node.args[0]
+            if isinstance(arg, ast.Name) and "metric" in arg.id.lower():
+                yield _finding(
+                    rule,
+                    node,
+                    f"{node.func.id}() on step metrics in {where} "
+                    f"{fn.name}() implicitly syncs a device scalar to "
+                    "host",
+                )
+                continue
+            if (
+                isinstance(arg, (ast.Subscript, ast.Attribute))
+                and _metric_rooted(arg)
+            ):
+                yield _finding(
+                    rule,
+                    node,
+                    f"{node.func.id}() on a metrics subscript/attribute "
+                    f"in {where} {fn.name}() implicitly syncs a device "
+                    "scalar to host",
+                )
+                continue
+        resolved = module.resolve_call(node)
+        if resolved in HostSyncInHotLoop.SYNC_CALLS:
+            yield _finding(
+                rule,
+                node,
+                f"{HostSyncInHotLoop.SYNC_CALLS[resolved]} in {where} "
+                f"{fn.name}() — {coda}",
+            )
+            continue
+        if (
+            isinstance(node.func, ast.Attribute)
+            and node.func.attr in HostSyncInHotLoop.SYNC_METHODS
+            and not node.args
+            and not node.keywords
+        ):
+            yield _finding(
+                rule,
+                node,
+                f"{HostSyncInHotLoop.SYNC_METHODS[node.func.attr]} in "
+                f"{where} {fn.name}() — {coda}",
+            )
+
+
 class RecorderHotLoopSync(Rule):
     """Host sync on step metrics inside the recorded hot loop.
 
@@ -834,81 +900,85 @@ class RecorderHotLoopSync(Rule):
         {"observe_batch", "on_step", "note_metrics", "wrap_place"}
     )
 
-    def _metric_root(self, node) -> bool:
-        """True when the expression is rooted at a metrics-named value."""
-        while isinstance(node, (ast.Attribute, ast.Subscript)):
-            node = node.value
-        return isinstance(node, ast.Name) and "metric" in node.id.lower()
+    def check(self, module):
+        for fn in module.functions:
+            if fn.name in self.RECORDER_FUNCTIONS:
+                yield from _metrics_sync_findings(
+                    self, module, fn,
+                    where="recorder hot path",
+                    coda="recording must not add per-step syncs",
+                )
+            elif fn.name in HOT_FUNCTIONS:
+                # In fit/evaluate only the implicit-__float__ sync on a
+                # BARE metrics name is this rule's beat (SAV101's
+                # subscript/attribute heuristic cannot see it); the
+                # rest of the hot-loop sync catalogue is SAV101's.
+                for node in _walk_excluding_nested(fn):
+                    if (
+                        isinstance(node, ast.Call)
+                        and isinstance(node.func, ast.Name)
+                        and node.func.id in ("float", "int")
+                        and len(node.args) == 1
+                        and isinstance(node.args[0], ast.Name)
+                        and "metric" in node.args[0].id.lower()
+                    ):
+                        yield _finding(
+                            self,
+                            node,
+                            f"{node.func.id}() on step metrics in "
+                            f"{fn.name}() implicitly syncs a device "
+                            "scalar to host",
+                        )
+
+
+# ---------------------------------------------------------------- SAV112
+
+
+class FleetHotPathSync(Rule):
+    """Host sync in the fleet-telemetry / anomaly-profiler hot path.
+
+    The fleet layer's steady-state contract (sav_tpu/obs/fleet.py,
+    sav_tpu/obs/autoprof.py, docs/fleet.md) mirrors the flight
+    recorder's (SAV111): a heartbeat is one appended JSON line built
+    from values that are *already* host-side at the trainer's log
+    boundary — the goodput ledger's wall-clock aggregates and the
+    metrics dict fit() synced anyway — and the profiler's arm/disarm
+    path is pure host bookkeeping. A ``device_get`` /
+    ``block_until_ready`` / ``.item()`` slipped into ``beat()`` /
+    ``fleet_event()`` / ``note_window()`` / ``request()``, or a
+    ``float(metrics...)`` pulling a device scalar through
+    ``__float__``, would turn every logging window into a pipeline
+    drain across the whole fleet. These functions sit outside SAV101's
+    fit/evaluate scope (and outside SAV111's recorder set), so SAV112
+    owns them.
+    """
+
+    id = "SAV112"
+    name = "fleet-hot-path-sync"
+    severity = "error"
+    hint = (
+        "keep the fleet heartbeat/autoprof path host-only (heartbeats "
+        "carry values the trainer already synced at its log boundary); "
+        "if a sync here is truly intentional, pragma it with a "
+        "justification"
+    )
+
+    # The fleet layer's per-beat surface. Deliberately DISJOINT from
+    # SAV111's RECORDER_FUNCTIONS — overlapping scopes would double-
+    # report the same call. GoodputLedger.note_window shares a name and
+    # the same obligation (host math only), so the rule covers it too.
+    FLEET_FUNCTIONS = frozenset(
+        {"beat", "fleet_event", "note_window", "request"}
+    )
 
     def check(self, module):
-        scope = HOT_FUNCTIONS | self.RECORDER_FUNCTIONS
         for fn in module.functions:
-            if fn.name not in scope:
-                continue
-            recorder_scope = fn.name in self.RECORDER_FUNCTIONS
-            for node in _walk_excluding_nested(fn):
-                if not isinstance(node, ast.Call):
-                    continue
-                # float()/int() on a bare metrics-named value: the
-                # implicit-__float__ sync SAV101's subscript/attribute
-                # check cannot see. Flagged in both scopes.
-                if (
-                    isinstance(node.func, ast.Name)
-                    and node.func.id in ("float", "int")
-                    and len(node.args) == 1
-                    and isinstance(node.args[0], ast.Name)
-                    and "metric" in node.args[0].id.lower()
-                ):
-                    yield _finding(
-                        self,
-                        node,
-                        f"{node.func.id}() on step metrics in "
-                        f"{fn.name}() implicitly syncs a device scalar "
-                        "to host",
-                    )
-                    continue
-                if not recorder_scope:
-                    continue  # in fit/evaluate the rest is SAV101's beat
-                resolved = module.resolve_call(node)
-                if resolved in HostSyncInHotLoop.SYNC_CALLS:
-                    yield _finding(
-                        self,
-                        node,
-                        f"{HostSyncInHotLoop.SYNC_CALLS[resolved]} in "
-                        f"recorder hot path {fn.name}() — recording must "
-                        "not add per-step syncs",
-                    )
-                    continue
-                if (
-                    isinstance(node.func, ast.Attribute)
-                    and node.func.attr in HostSyncInHotLoop.SYNC_METHODS
-                    and not node.args
-                    and not node.keywords
-                ):
-                    yield _finding(
-                        self,
-                        node,
-                        f"{HostSyncInHotLoop.SYNC_METHODS[node.func.attr]}"
-                        f" in recorder hot path {fn.name}() — recording "
-                        "must not add per-step syncs",
-                    )
-                    continue
-                if (
-                    isinstance(node.func, ast.Name)
-                    and node.func.id in ("float", "int")
-                    and len(node.args) == 1
-                    and isinstance(
-                        node.args[0], (ast.Subscript, ast.Attribute)
-                    )
-                    and self._metric_root(node.args[0])
-                ):
-                    yield _finding(
-                        self,
-                        node,
-                        f"{node.func.id}() on a metrics subscript/attribute "
-                        f"in recorder hot path {fn.name}() implicitly "
-                        "syncs a device scalar to host",
-                    )
+            if fn.name in self.FLEET_FUNCTIONS:
+                yield from _metrics_sync_findings(
+                    self, module, fn,
+                    where="fleet hot path",
+                    coda="heartbeating must not add device syncs",
+                )
 
 
 # ----------------------------------------------------------- SAV100 (meta)
@@ -973,6 +1043,7 @@ ALL_RULES = [
     JitInLoop(),
     AdhocSeedDerivation(),
     RecorderHotLoopSync(),
+    FleetHotPathSync(),
 ]
 
 

@@ -27,6 +27,15 @@ Five signals, one design rule each:
   step context (batch hash/raw batches, rng recipe, metrics, periodic
   state snapshots) dumped as a replayable incident bundle on nonfinite
   metrics, loss spikes, hangs, or crashes (``tools/replay_step.py``).
+- :mod:`sav_tpu.obs.fleet` — cross-process fleet telemetry: per-process
+  heartbeat streams (``fleet/proc_<i>.jsonl``), the merged fleet manifest
+  with step skew / straggler ranking / dead-host suspicion, and the
+  backend-probe timeline in the same artifact layout
+  (``tools/fleet_status.py``, docs/fleet.md).
+- :mod:`sav_tpu.obs.autoprof` — anomaly-triggered profiling: a goodput
+  stall anomaly, a robust step-time spike, or the watchdog's soft stage
+  arms a bounded ``jax.profiler`` window, budgeted like the recorder's
+  incidents and stamped into the run manifest.
 
 Re-exports are lazy (PEP 562, same pattern as :mod:`sav_tpu.utils`):
 :mod:`spans`, :mod:`goodput`, and :mod:`watchdog` are stdlib-only and must
@@ -49,6 +58,10 @@ _EXPORTS = {
     "resolve_peak_flops": "sav_tpu.obs.costs",
     "train_step_cost": "sav_tpu.obs.costs",
     "FlightRecorder": "sav_tpu.obs.recorder",
+    "HeartbeatWriter": "sav_tpu.obs.fleet",
+    "aggregate_fleet": "sav_tpu.obs.fleet",
+    "write_fleet_manifest": "sav_tpu.obs.fleet",
+    "AutoProfiler": "sav_tpu.obs.autoprof",
     "RunManifest": "sav_tpu.obs.manifest",
     "RunRecord": "sav_tpu.obs.manifest",
     "classify_exception": "sav_tpu.obs.manifest",

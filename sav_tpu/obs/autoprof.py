@@ -1,0 +1,280 @@
+"""Anomaly-triggered on-demand profiling — bounded jax.profiler captures.
+
+The static profile window (``TrainConfig.profile_dir`` + start/num steps)
+answers "what does a healthy steady-state step look like"; it is useless
+for the anomalies that actually cost goodput, because nobody knows at
+launch time *when* the stall will happen. This module closes that gap:
+when the run's own telemetry flags trouble — the goodput ledger's stall
+anomaly, a per-window step time beyond a robust (median + MAD) spike
+gate, or the hang watchdog crossing its soft (warning) stage —
+:class:`AutoProfiler` arms ``jax.profiler`` for a bounded N-step trace
+window, stamps the capture into the run manifest
+(``notes.autoprof``), and stands down.
+
+Budget discipline mirrors the flight recorder's ``max_incidents``: a
+pathology that recurs every window must not fill the disk with traces,
+so ``max_captures`` bounds the per-run total and a ``cooldown_steps``
+gap separates consecutive captures. Profiling is telemetry: every
+profiler call is wrapped so a failed capture (e.g. a trace already
+active from the static window) counts as ``errors`` instead of taking
+the run down.
+
+No device syncs: arming/starting/stopping are host-side profiler API
+calls driven from the trainer's existing loop positions (savlint SAV112
+pins the ``note_window``/``request`` path sync-free alongside the fleet
+heartbeat). The captured window is therefore *approximate* — it starts
+at the step boundary after the trigger — which is the right trade: the
+anomaly detector runs at log granularity anyway, and a sync to align
+the window would itself distort the thing being measured.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from collections import deque
+from typing import Callable, Optional
+
+from sav_tpu.obs.fleet import MAD_SCALE, _mad, _median
+
+TRIGGERS = (
+    "stall_anomaly",     # the goodput ledger flagged a stalled window
+    "step_time_spike",   # per-window step time beyond the robust gate
+    "watchdog_soft",     # the hang watchdog crossed its warning stage
+    "manual",            # explicit request (tools, tests)
+)
+
+
+class AutoProfiler:
+    """Arms a bounded ``jax.profiler`` trace window on anomaly triggers.
+
+    Driven by three call sites in the train loop, all host-side:
+    :meth:`on_step` at the top of every iteration (the state machine —
+    starts an armed capture, stops a finished one), :meth:`note_window`
+    at each log boundary with the window's per-step wall time (the
+    internal spike gate), and :meth:`request` wherever an external
+    detector fires (ledger stall anomaly, watchdog soft stage —
+    any thread). ``start_fn``/``stop_fn`` are injectable for tests;
+    production resolves :mod:`sav_tpu.utils.profiler` lazily so this
+    module imports without jax.
+    """
+
+    def __init__(
+        self,
+        log_dir: str,
+        *,
+        trace_steps: int = 4,
+        max_captures: int = 2,
+        cooldown_steps: int = 16,
+        spike_sigma: float = 4.0,
+        spike_window: int = 32,
+        spike_min_history: int = 8,
+        process_index: int = 0,
+        manifest=None,
+        start_fn: Optional[Callable[[str], None]] = None,
+        stop_fn: Optional[Callable[[], None]] = None,
+    ):
+        if trace_steps < 1:
+            raise ValueError(f"trace_steps must be >= 1, got {trace_steps}")
+        if max_captures < 1:
+            raise ValueError(
+                f"max_captures must be >= 1, got {max_captures}"
+            )
+        self.log_dir = log_dir
+        self.trace_steps = trace_steps
+        self.max_captures = max_captures
+        self.cooldown_steps = cooldown_steps
+        self.spike_sigma = spike_sigma
+        self.spike_min_history = spike_min_history
+        self.process_index = int(process_index)
+        self.manifest = manifest
+        self._start_fn = start_fn
+        self._stop_fn = stop_fn
+        self._lock = threading.Lock()
+        self._armed: Optional[dict] = None     # {trigger, step} pending
+        self._active: Optional[dict] = None    # capture in flight
+        self._last_end_step: Optional[int] = None
+        self._step_history: deque = deque(maxlen=spike_window)
+        self.captures: list[dict] = []
+        self.denied = 0
+        self.errors = 0
+
+    # -------------------------------------------------------------- triggers
+
+    def request(self, trigger: str, step: int) -> bool:
+        """Arm a capture for ``trigger`` at ``step``; True iff armed.
+
+        Denials (budget spent, capture already armed/active, inside the
+        cooldown window) are counted, not raised — detectors fire at
+        will and the budget is the backstop. Thread-safe: the watchdog's
+        soft stage calls this from its own thread.
+        """
+        if trigger not in TRIGGERS:
+            raise ValueError(f"unknown trigger {trigger!r}; use {TRIGGERS}")
+        with self._lock:
+            if self._armed is not None or self._active is not None:
+                self.denied += 1
+                return False
+            if len(self.captures) >= self.max_captures:
+                self.denied += 1
+                return False
+            if (
+                self._last_end_step is not None
+                and step - self._last_end_step < self.cooldown_steps
+            ):
+                self.denied += 1
+                return False
+            self._armed = {"trigger": trigger, "step": int(step)}
+            return True
+
+    def note_window(self, step: int, per_step_s: float) -> Optional[str]:
+        """Feed one log window's per-step wall time through the robust
+        spike gate (median + ``spike_sigma`` scaled MADs over the rolling
+        healthy history, upward only — the recorder's loss-spike
+        machinery applied to time). Returns the trigger name when it
+        fired and armed a capture."""
+        if not isinstance(per_step_s, (int, float)) or per_step_s <= 0:
+            return None
+        history = list(self._step_history)
+        spiked = False
+        if self.spike_sigma and len(history) >= self.spike_min_history:
+            # fleet.py's robust helpers — one median/MAD implementation
+            # for the whole fleet layer (itself the sentinel's machinery).
+            med = _median(history)
+            mad = _mad(history, med)
+            threshold = self.spike_sigma * max(
+                MAD_SCALE * mad, 0.05 * abs(med), 1e-9
+            )
+            spiked = per_step_s > med + threshold
+        if not spiked:
+            # Flagged windows stay out of the history so one spike
+            # cannot poison the baseline (goodput.py's discipline).
+            self._step_history.append(float(per_step_s))
+            return None
+        if self.request("step_time_spike", step):
+            return "step_time_spike"
+        return None
+
+    # --------------------------------------------------------- state machine
+
+    def _resolve_profiler(self):
+        from sav_tpu.utils import profiler
+
+        return profiler.start_trace, profiler.stop_trace
+
+    def on_step(self, step: int) -> None:
+        """Drive the capture window from the train loop (top of each
+        iteration): stop a finished capture, then start an armed one so
+        the window covers whole steps."""
+        with self._lock:
+            active = self._active
+            armed = self._armed
+        if active is not None and step >= active["stop_step"]:
+            self._finish(step)
+            return
+        if active is None and armed is not None:
+            self._begin(step, armed)
+
+    def _begin(self, step: int, armed: dict) -> None:
+        path = os.path.join(
+            self.log_dir,
+            "autoprof",
+            f"proc{self.process_index}_step{step:08d}_{armed['trigger']}",
+        )
+        start_fn = self._start_fn
+        try:
+            if start_fn is None:
+                start_fn, _ = self._resolve_profiler()
+            os.makedirs(path, exist_ok=True)
+            start_fn(path)
+        except Exception:
+            # A capture that cannot start (profiler already tracing for
+            # the static window, unwritable dir) is an error to count,
+            # never a run-killer; disarm so the trigger can re-fire
+            # later rather than wedging the state machine.
+            with self._lock:
+                self.errors += 1
+                self._armed = None
+            return
+        with self._lock:
+            self._active = {
+                "trigger": armed["trigger"],
+                "trigger_step": armed["step"],
+                "start_step": int(step),
+                "stop_step": int(step) + self.trace_steps,
+                "path": path,
+            }
+            self._armed = None
+
+    def _finish(self, step: int) -> None:
+        stop_fn = self._stop_fn
+        try:
+            if stop_fn is None:
+                _, stop_fn = self._resolve_profiler()
+            stop_fn()
+        except Exception:
+            with self._lock:
+                self.errors += 1
+                self._active = None
+            return
+        with self._lock:
+            active = self._active
+            self._active = None
+            if active is None:
+                return
+            capture = {
+                "trigger": active["trigger"],
+                "trigger_step": active["trigger_step"],
+                "start_step": active["start_step"],
+                "end_step": int(step),
+                "path": active["path"],
+                "t_unix": round(time.time(), 3),
+            }
+            self.captures.append(capture)
+            self._last_end_step = int(step)
+            captures = list(self.captures)
+        # Per-process sidecar FIRST: in a multi-host run every non-zero
+        # process carries a DISABLED run manifest (process 0 owns
+        # manifest.json), and the straggler's own trace is exactly the
+        # capture that must not vanish — tools/fleet_status.py merges
+        # these sidecars with notes.autoprof.
+        try:
+            sidecar = os.path.join(
+                self.log_dir, "autoprof",
+                f"proc{self.process_index}_captures.jsonl",
+            )
+            with open(sidecar, "a") as f:
+                f.write(json.dumps(capture) + "\n")
+        except OSError:
+            pass
+        if self.manifest is not None:
+            try:
+                self.manifest.note("autoprof", captures)
+            except Exception:
+                pass
+
+    def finalize(self, step: Optional[int] = None) -> None:
+        """Stop an in-flight capture (fit()'s finally): a crash inside
+        the window must still leave a finished, manifest-stamped trace."""
+        with self._lock:
+            active = self._active
+        if active is not None:
+            self._finish(
+                step if step is not None else active["start_step"]
+            )
+
+    @property
+    def active(self) -> bool:
+        with self._lock:
+            return self._active is not None
+
+    def stats(self) -> dict[str, float]:
+        """Gauge view for the goodput ledger (``autoprof/*``)."""
+        with self._lock:
+            return {
+                "captures": float(len(self.captures)),
+                "denied": float(self.denied),
+                "errors": float(self.errors),
+            }

@@ -73,6 +73,20 @@ class HangWatchdog:
     contract outranks telemetry. ``exit_fn``/``stream`` are
     injectable for tests — production uses ``os._exit`` so a wedged main
     thread cannot swallow the abort.
+
+    **Two-stage escalation** (``soft_deadline_s``): an optional *soft*
+    (warning) stage below the hard deadline. Crossing it dumps every
+    thread's stack and invokes ``on_soft(silent_s)`` — the trainer wires
+    that to a fleet-heartbeat event plus arming the anomaly profiler
+    (sav_tpu.obs.fleet / sav_tpu.obs.autoprof, docs/fleet.md) — but the
+    run *continues*: a slow eval or a transient relay stall recovers,
+    and the evidence of where it was stuck is already on disk if it
+    does not. The soft stage fires once per silent episode (re-armed by
+    the next beat); the hard stage's exit-4 contract is unchanged.
+    ``on_soft`` runs on a side thread bounded by ``dump_timeout_s`` and
+    is exception-guarded — the log dir's filesystem may be the stall's
+    own cause, and neither a failing nor a *blocking* callback may stop
+    the hard stage from ever firing.
     """
 
     def __init__(
@@ -88,10 +102,21 @@ class HangWatchdog:
         stream=None,
         poll_s: Optional[float] = None,
         dump_timeout_s: float = 30.0,
+        soft_deadline_s: Optional[float] = None,
+        on_soft: Optional[Callable[[float], None]] = None,
     ):
         if deadline_s <= 0:
             raise ValueError(f"deadline_s must be positive, got {deadline_s}")
+        if soft_deadline_s is not None and not (
+            0 < soft_deadline_s < deadline_s
+        ):
+            raise ValueError(
+                f"soft_deadline_s must be in (0, deadline_s={deadline_s}), "
+                f"got {soft_deadline_s}"
+            )
         self.deadline_s = deadline_s
+        self.soft_deadline_s = soft_deadline_s
+        self.on_soft = on_soft
         self.ledger = ledger
         self.manifest = manifest
         self.recorder = recorder
@@ -104,6 +129,9 @@ class HangWatchdog:
         self._last_beat = time.monotonic()
         self._stop = threading.Event()
         self.fired = threading.Event()
+        self.soft_fired = threading.Event()
+        self.soft_count = 0
+        self._soft_fired_episode = False
         self._thread: Optional[threading.Thread] = None
 
     def beat(self) -> None:
@@ -139,6 +167,76 @@ class HangWatchdog:
             if silent_s >= self.deadline_s:
                 self._fire(silent_s)
                 return
+            if self.soft_deadline_s is not None:
+                if silent_s >= self.soft_deadline_s:
+                    if not self._soft_fired_episode:
+                        self._soft_fired_episode = True
+                        self._fire_soft(silent_s)
+                else:
+                    # A beat arrived since the soft fire: the episode is
+                    # over, re-arm the warning stage for the next stall.
+                    self._soft_fired_episode = False
+
+    def _fire_soft(self, silent_s: float) -> None:
+        """Warning stage: evidence to disk, run continues.
+
+        The dump + ``on_soft`` run on a side thread bounded by
+        ``dump_timeout_s`` — the same discipline as the hard stage's
+        recorder dump, and for the same reason: the callback writes to
+        the very log dir whose filesystem may BE the stall's cause (or
+        waits on a lock a wedged training thread holds), and a blocked
+        monitor thread would silently void the hard stage's
+        guaranteed-exit contract. Exceptions are printed, never raised.
+        """
+        stream = self._stream if self._stream is not None else sys.stderr
+        print(
+            f"{self.tag}: SOFT — no step completed in {silent_s:.0f}s "
+            f"(soft deadline {self.soft_deadline_s:.0f}s, hard "
+            f"{self.deadline_s:.0f}s); dumping stacks, run continues",
+            file=stream,
+        )
+
+        def _dump():
+            try:
+                dump_all_stacks(stream)
+                if self.ledger is not None:
+                    print(
+                        f"{self.tag}: goodput ledger at soft stage: "
+                        + json.dumps(self.ledger.summary()),
+                        file=stream,
+                    )
+            except Exception as e:
+                print(f"{self.tag}: soft dump failed: {e!r}", file=stream)
+            if self.on_soft is not None:
+                try:
+                    self.on_soft(silent_s)
+                except Exception as e:
+                    print(f"{self.tag}: on_soft failed: {e!r}", file=stream)
+            try:
+                stream.flush()
+            except Exception:
+                pass
+
+        dumper = threading.Thread(
+            target=_dump, name=f"{self.tag}-soft-dump", daemon=True
+        )
+        dumper.start()
+        # Never wait past the hard deadline: the monitor thread must be
+        # back polling silent_s when it expires, or a wedged dump would
+        # delay the exit-4 contract wrapper scripts key on.
+        dumper.join(timeout=min(
+            self._dump_timeout_s,
+            max(self.deadline_s - silent_s, 0.1),
+        ))
+        if dumper.is_alive():
+            print(
+                f"{self.tag}: soft-stage dump still blocked after "
+                f"{self._dump_timeout_s:.0f}s (wedged filesystem?); "
+                "abandoning it — the hard deadline stays armed",
+                file=stream,
+            )
+        self.soft_count += 1
+        self.soft_fired.set()
 
     def _fire(self, silent_s: float) -> None:
         stream = self._stream if self._stream is not None else sys.stderr

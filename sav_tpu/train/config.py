@@ -162,6 +162,32 @@ class TrainConfig:
     # no step completes within this many seconds (None disables). Armed
     # after the first step so compile time cannot false-fire it.
     watchdog_secs: Optional[float] = None
+    # Watchdog soft (warning) stage (docs/fleet.md): when no step
+    # completes within this many seconds — must be < watchdog_secs — the
+    # watchdog dumps all thread stacks + a fleet-heartbeat event and arms
+    # the anomaly profiler, but the run CONTINUES; only the hard
+    # watchdog_secs deadline keeps the exit-4 contract. None disables
+    # the soft stage.
+    watchdog_soft_secs: Optional[float] = None
+    # Fleet telemetry (sav_tpu.obs.fleet; docs/fleet.md): every process
+    # appends a heartbeat record (step, goodput buckets, HBM/retrace
+    # telemetry, last incident pointer) to <log_dir>/fleet/proc_<i>.jsonl
+    # at the existing log boundary — zero extra device syncs (savlint
+    # SAV112) — and process 0 writes the merged fleet manifest
+    # (fleet/fleet.json: step skew, straggler ranking, dead-host
+    # suspicion) at the end of fit. Requires a log_dir/checkpoint_dir
+    # sink; render with tools/fleet_status.py or run_report.py --fleet.
+    fleet: bool = True
+    # Anomaly-triggered profiling (sav_tpu.obs.autoprof; docs/fleet.md):
+    # when the goodput ledger flags a stall anomaly, a log window's
+    # per-step time spikes past a robust median+MAD gate, or the
+    # watchdog crosses its soft stage, arm jax.profiler for a bounded
+    # autoprof_steps-step trace under <log_dir>/autoprof/, stamped into
+    # the run manifest (notes.autoprof). Budgeted like the flight
+    # recorder's incidents: at most autoprof_max captures per run.
+    autoprof: bool = False
+    autoprof_steps: int = 4
+    autoprof_max: int = 2
     # Per-chip peak FLOP/s override for MFU/roofline accounting
     # (sav_tpu/obs/costs.py; train.py --peak-flops). None = resolve from
     # the device-kind table; unknown accelerators then report no MFU, and

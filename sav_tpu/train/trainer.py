@@ -232,9 +232,21 @@ class Trainer:
 
     # ------------------------------------------------------------------ init
 
-    def _dummy_input(self) -> jax.Array:
+    def _dummy_shape(self) -> tuple:
         s = self.config.image_size
-        return jnp.zeros((2, s, s, 3), self.compute_dtype)
+        # Batch sized to the mesh's batch-axes product: init traces the
+        # model once, and under sequence parallelism a batch that does
+        # not divide the data axes takes the replication fallback — the
+        # MULTICHIP_r05 warning came from exactly this dummy (batch 2 vs
+        # a data axis of 4 in the talking-heads SP leg), not from any
+        # real training batch. Shape only: the zeros materialize inside
+        # the jitted init_fn (traced, never a host buffer), so a 256-way
+        # data axis does not cost a concrete global-batch-sized array.
+        b = max(
+            2,
+            int(np.prod([self.mesh.shape[a] for a in batch_axes(self.mesh)])),
+        )
+        return (b, s, s, 3)
 
     def init_state(self, seed: Optional[int] = None) -> TrainState:
         """Build a sharded TrainState directly on the mesh.
@@ -244,9 +256,10 @@ class Trainer:
         single host buffer.
         """
         rng = jax.random.PRNGKey(self.config.seed if seed is None else seed)
-        dummy = self._dummy_input()
+        dummy_shape = self._dummy_shape()
 
         def init_fn(rng):
+            dummy = jnp.zeros(dummy_shape, self.compute_dtype)
             variables = self.model.init({"params": rng}, dummy, is_training=False)
             variables = dict(variables)
             params = variables.pop("params")
@@ -893,10 +906,20 @@ class Trainer:
         rng = jax.random.fold_in(jax.random.PRNGKey(cfg.seed), 1)
         history: list[dict] = []
         obs_dir = cfg.log_dir or cfg.checkpoint_dir
-        # Telemetry files are written by process 0 only — multi-host runs
+        # Telemetry files are written by FLEET process 0 only — runs
         # share --log-dir (the rsync/report workflow) and concurrent
-        # writers would clobber each other.
-        obs_writer = jax.process_index() == 0
+        # writers would clobber each other. Identity defaults to jax's
+        # process index; the SAV_FLEET_PROC/_PROCS override covers
+        # fleets not coordinated through jax.distributed (independent
+        # workers sharing a log dir), where every worker is jax process
+        # 0 and would otherwise clobber goodput.json/spans — only the
+        # per-process heartbeat streams below are written by everyone.
+        from sav_tpu.obs.fleet import resolve_identity as _fleet_identity
+
+        fleet_proc, fleet_procs = _fleet_identity(
+            jax.process_index(), jax.process_count()
+        )
+        obs_writer = fleet_proc == 0
         tracer = SpanTracer(
             os.path.join(obs_dir or ".", "spans.trace.json")
             if cfg.trace_spans and obs_writer else None
@@ -926,17 +949,68 @@ class Trainer:
             recorder = FlightRecorder.from_config(
                 cfg, obs_dir or ".", manifest=manifest
             )
+        fleet_hb = None
+        if cfg.fleet and obs_dir is not None:
+            # Fleet heartbeats (sav_tpu.obs.fleet; docs/fleet.md): EVERY
+            # process appends to its own fleet/proc_<i>.jsonl — unlike the
+            # other telemetry writers this is deliberately not process-0
+            # gated, because per-process streams ARE the product (the
+            # aggregator attributes stragglers/dead hosts across them).
+            # The per-beat path is host-only (savlint SAV112) and rides
+            # the existing log boundary.
+            from sav_tpu.obs.fleet import HeartbeatWriter
+
+            fleet_hb = HeartbeatWriter(
+                obs_dir,
+                process_index=fleet_proc,
+                process_count=fleet_procs,
+            )
+        autoprof = None
+        if cfg.autoprof and obs_dir is not None:
+            # Anomaly-triggered bounded jax.profiler windows
+            # (sav_tpu.obs.autoprof): armed by the ledger's stall
+            # anomaly, the per-window step-time spike gate, or the
+            # watchdog's soft stage; per-process (a straggler diagnosis
+            # needs the straggler's own trace), capture-budgeted like
+            # the recorder's incidents.
+            from sav_tpu.obs.autoprof import AutoProfiler
+
+            autoprof = AutoProfiler(
+                obs_dir,
+                trace_steps=cfg.autoprof_steps,
+                max_captures=cfg.autoprof_max,
+                process_index=fleet_proc,
+                manifest=manifest,
+            )
         watchdog = None
         if cfg.watchdog_secs:
             from sav_tpu.obs.watchdog import HangWatchdog
 
+            def _on_watchdog_soft(silent_s, _hb=fleet_hb, _ap=autoprof):
+                # Warning-stage evidence (watchdog thread, host-only):
+                # a fleet event marks WHEN this process stalled in the
+                # shared artifact layout, and the profiler arms so a
+                # stall that resumes slowly gets captured.
+                at_step = start_step + ledger.steps
+                if _hb is not None:
+                    _hb.fleet_event(
+                        "watchdog_soft", silent_s=round(silent_s, 1),
+                        at_step=at_step,
+                    )
+                if _ap is not None:
+                    _ap.request("watchdog_soft", at_step)
+
             # NOTE: the deadline must exceed the longest legitimate gap
             # between completed steps — an eval pass or checkpoint save
             # counts one beat at its end, so size watchdog_secs above the
-            # slowest of those, not just above the step time.
+            # slowest of those, not just above the step time. The soft
+            # stage (cfg.watchdog_soft_secs) warns + snapshots below it
+            # without aborting.
             watchdog = HangWatchdog(
                 cfg.watchdog_secs, ledger=ledger, tag="train-watchdog",
                 manifest=manifest, recorder=recorder,
+                soft_deadline_s=cfg.watchdog_soft_secs,
+                on_soft=_on_watchdog_soft,
             )
         # Cost model (sav_tpu/obs/costs.py): an analytic per-layer-group
         # FLOPs estimate exists up front on any backend; the total is
@@ -1063,6 +1137,12 @@ class Trainer:
         inflight_metrics: deque = deque()
         try:
             for step in range(start_step, num_steps):
+                if autoprof is not None:
+                    # Host-side state machine: starts an armed anomaly
+                    # capture at this step boundary, stops one whose
+                    # bounded window is over. No device syncs — the
+                    # window is approximate by design.
+                    autoprof.on_step(step)
                 if cfg.profile_dir is not None:
                     # Steps dispatch asynchronously: sync the device at both
                     # window edges so the trace covers exactly the intended
@@ -1193,6 +1273,16 @@ class Trainer:
                     steps_since = step + 1 - last_logged_step
                     if ledger.note_window(steps_since, window_s, step=step + 1):
                         tracer.instant("stall_anomaly", step=step + 1)
+                        if autoprof is not None:
+                            autoprof.request("stall_anomaly", step + 1)
+                    if autoprof is not None:
+                        # Wall per-step (host view: includes input wait +
+                        # collective wait, unlike the ledger's dispatch
+                        # window) through the robust spike gate.
+                        autoprof.note_window(
+                            step + 1,
+                            (now - t_last) / max(steps_since, 1),
+                        )
                     window_s = 0.0
                     m["images_per_sec"] = (
                         cfg.global_batch_size * steps_since / max(now - t_last, 1e-9)
@@ -1229,6 +1319,19 @@ class Trainer:
                                     "incident", step=step + 1,
                                     trigger=trigger,
                                 )
+                    if fleet_hb is not None:
+                        # Fleet heartbeat: one appended line from values
+                        # this window already holds on the host (the
+                        # synced metrics dict + the ledger's wall-clock
+                        # aggregates) — SAV112 pins the path sync-free.
+                        fleet_hb.beat(
+                            step + 1, ledger=ledger, metrics=m,
+                            incident=(
+                                recorder.incidents[-1]["path"]
+                                if recorder is not None
+                                and recorder.incidents else None
+                            ),
+                        )
                 epoch_done = (step + 1) % cfg.steps_per_epoch == 0
                 if epoch_done:
                     epoch = (step + 1) // cfg.steps_per_epoch
@@ -1263,6 +1366,8 @@ class Trainer:
                             step=step + 1,
                         ):
                             tracer.instant("stall_anomaly", step=step + 1)
+                            if autoprof is not None:
+                                autoprof.request("stall_anomaly", step + 1)
                         window_s = 0.0
                         last_logged_step = step + 1
                 if watchdog is not None:
@@ -1327,6 +1432,64 @@ class Trainer:
                 feeder.close()
             if watchdog is not None:
                 watchdog.stop()
+            if autoprof is not None:
+                # A crash inside a capture window still leaves a
+                # finished, manifest-stamped trace behind.
+                autoprof.finalize()
+                for k, v in autoprof.stats().items():
+                    ledger.set_gauge(f"autoprof/{k}", v)
+            if fleet_hb is not None:
+                for k, v in fleet_hb.stats().items():
+                    ledger.set_gauge(f"fleet/{k}", v)
+                exc = sys.exc_info()[1]
+                fleet_hb.close(
+                    outcome="ok"
+                    if exc is None or isinstance(exc, StopIteration)
+                    else "error"
+                )
+                if fleet_hb.process_index == 0:
+                    # Merged fleet manifest (FLEET process 0's in-run
+                    # view — offline tools recompute over the final
+                    # streams): step skew, straggler ranking, dead-host
+                    # suspicion. Gated on the fleet identity, not
+                    # obs_writer, so identity-overridden fleets still
+                    # get exactly one writer.
+                    from sav_tpu.obs.fleet import (
+                        aggregate_fleet,
+                        write_fleet_manifest,
+                    )
+
+                    try:
+                        fleet_summary = aggregate_fleet(obs_dir)
+                        fleet_path = write_fleet_manifest(
+                            obs_dir, fleet_summary
+                        )
+                        if manifest is not None and fleet_path is not None:
+                            manifest.note("fleet", {
+                                "path": fleet_path,
+                                "processes": {
+                                    p: {
+                                        "heartbeats": v.get("heartbeats"),
+                                        "last_step": v.get("last_step"),
+                                        "outcome": v.get("outcome"),
+                                    }
+                                    for p, v in fleet_summary.get(
+                                        "processes", {}
+                                    ).items()
+                                },
+                                "step_skew": fleet_summary.get("step_skew"),
+                                "straggler": (
+                                    fleet_summary.get("straggler") or {}
+                                ).get("straggler"),
+                                "suspects": [
+                                    s.get("proc")
+                                    for s in fleet_summary.get(
+                                        "suspects", []
+                                    )
+                                ],
+                            })
+                    except Exception:
+                        pass  # fleet aggregation is telemetry, never fatal
             if sanitizer is not None:
                 # Thread-local config context: must unwind on this (the
                 # entering) thread before fit returns.

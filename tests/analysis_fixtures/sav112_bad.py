@@ -1,0 +1,24 @@
+"""Known-bad fixture for SAV112: device syncs in the fleet heartbeat /
+anomaly-profiler hot path — sync calls inside beat()/fleet_event(), a
+float() pulling a device metric scalar through __float__ in beat(), and
+a pipeline drain inside the profiler's note_window() gate."""
+import jax
+
+
+class HeartbeatWriter:
+    def beat(self, step, metrics):
+        snapshot = jax.device_get(metrics)
+        self.last_loss = float(metrics["loss"])
+        self.records.append(snapshot)
+
+    def fleet_event(self, event, state):
+        state.params.block_until_ready()
+        self.events.append(event)
+
+
+class AutoProfiler:
+    def note_window(self, step, per_step_s, metrics):
+        self.history.append(metrics["loss"].item())
+
+    def request(self, trigger, step, metrics):
+        self.last = float(metrics)

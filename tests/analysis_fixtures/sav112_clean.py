@@ -1,0 +1,37 @@
+"""Known-clean fixture for SAV112: the nearest legitimate idioms — the
+heartbeat carries values the trainer already synced at its log boundary
+(host floats by contract), the profiler's gate is host math, and the
+event path is pure bookkeeping."""
+import json
+
+
+class HeartbeatWriter:
+    def beat(self, step, ledger, metrics):
+        # The metrics dict is host-side by contract (the trainer's
+        # log-boundary device_get produced it); extracting named host
+        # floats is not a sync.
+        record = {"step": step, "wall_s": ledger.wall_s}
+        loss = metrics.get("loss")
+        if isinstance(loss, (int, float)):
+            record["loss"] = float(loss)
+        self.file.write(json.dumps(record) + "\n")
+        self.file.flush()
+
+    def fleet_event(self, event, silent_s):
+        self.file.write(json.dumps({"event": event, "silent_s": silent_s}))
+
+
+class AutoProfiler:
+    def note_window(self, step, per_step_s):
+        # Robust spike gate over host wall-clock floats.
+        history = sorted(self.history)
+        if history and per_step_s > 4.0 * history[len(history) // 2]:
+            return self.request("step_time_spike", step)
+        self.history.append(per_step_s)
+
+    def request(self, trigger, step):
+        if len(self.captures) >= self.max_captures:
+            self.denied += 1
+            return False
+        self.armed = {"trigger": trigger, "step": step}
+        return True

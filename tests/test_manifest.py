@@ -254,3 +254,13 @@ def test_bench_abort_emits_parseable_json_line(tmp_path, capsys):
     assert "bench: accelerator backend unreachable within " \
         "--backend-wait=600s; aborting" in captured.err
     assert RunManifest.load(m.path)["outcome"] == "backend_unreachable"
+    # ISSUE 7 satellite: the probe timeline also lands in the fleet
+    # artifact layout, so "backend never came up" (probe lines, no
+    # heartbeats) and "backend died mid-run" (heartbeats that stop) are
+    # distinguishable from one directory (docs/fleet.md).
+    timeline = record["probe_timeline"]
+    assert timeline == str(tmp_path / "fleet" / "backend_probe.jsonl")
+    lines = [json.loads(ln) for ln in open(timeline)]
+    assert [r["kind"] for r in lines] == ["probe", "probe", "probe_giveup"]
+    assert lines[-1]["attempts"] == 2
+    assert lines[0]["tag"] == "bench" and lines[0]["elapsed_s"] == 90.0

@@ -56,6 +56,11 @@ def _config(tmp_path, **overrides):
         log_every_steps=1,
         log_dir=str(tmp_path),
         diagnostics=True,
+        # The recorder suite measures the RECORDER's steady-state cost
+        # (the <2% overhead guard): keep the fleet heartbeat writer out
+        # of these fits so the guard isolates the contract under test —
+        # the fleet path carries its own <1% guard (tests/test_fleet.py).
+        fleet=False,
         record=True,
         record_depth=8,
         record_batches=4,

@@ -91,6 +91,13 @@ BAD_EXPECTATIONS = {
         ("SAV111", 20),  # metrics[...].item() in note_metrics()
         ("SAV111", 21),  # float(metrics[...]) in note_metrics()
     ],
+    "sav112_bad.py": [
+        ("SAV112", 10),  # jax.device_get in the heartbeat's beat()
+        ("SAV112", 11),  # float(metrics[...]) in beat()
+        ("SAV112", 15),  # .block_until_ready() in fleet_event()
+        ("SAV112", 21),  # metrics[...].item() in autoprof note_window()
+        ("SAV112", 24),  # float(metrics) on a bare name in request()
+    ],
 }
 
 CLEAN_FIXTURES = [
@@ -105,6 +112,7 @@ CLEAN_FIXTURES = [
     "sav109_clean.py",
     "sav110_clean.py",
     "sav111_clean.py",
+    "sav112_clean.py",
 ]
 
 

@@ -62,10 +62,13 @@ def test_trainer_hot_loop_suppressions_are_the_known_set():
     # sync, boundary reads, and the flight recorder's periodic pre-step
     # snapshot — the ONE sync recording adds, at its configured cadence)
     # + the serial-fallback SAV106. The recorder's per-step path itself
-    # must stay sync-free: that is SAV111's beat, with zero suppressions.
+    # must stay sync-free: that is SAV111's beat, with zero suppressions
+    # — and the fleet heartbeat/autoprof path likewise (SAV112, zero
+    # suppressions: heartbeating adds NO device syncs).
     assert rules.count("SAV101") == 9
     assert rules.count("SAV106") == 1
     assert rules.count("SAV111") == 0
+    assert rules.count("SAV112") == 0
     assert len(suppressed) == 10
 
 

@@ -19,10 +19,18 @@ import sys
 
 import pytest
 
+# Skip ONLY on rendezvous-setup failures (sandbox forbids the local TCP
+# coordinator) — narrow patterns so a genuine mid-run distributed
+# regression (which also surfaces barrier/UNAVAILABLE text) still FAILS.
+SETUP_ERRORS = (
+    "Address already in use",
+    "Permission denied",
+    "Failed to connect to coordinator",
+    "Cannot assign requested address",
+)
 
-@pytest.mark.slow
-@pytest.mark.parametrize("mode", ["dp", "tp", "sp", "pp", "ep", "fsdp"])
-def test_two_process_smoke(mode):
+
+def _run_smoke(mode, timeout):
     repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
     proc = subprocess.run(
         [
@@ -32,22 +40,39 @@ def test_two_process_smoke(mode):
         ],
         capture_output=True,
         text=True,
-        # Per-mode budget: 2 workers (600s communicate each, overlapping)
-        # plus the tp/sp/pp single-process reference (900s) on a contended
-        # 1-core host.
-        timeout=1800,
+        timeout=timeout,
     )
     out = proc.stdout + proc.stderr
-    # Skip ONLY on rendezvous-setup failures (sandbox forbids the local TCP
-    # coordinator) — narrow patterns so a genuine mid-run distributed
-    # regression (which also surfaces barrier/UNAVAILABLE text) still FAILS.
-    setup_errors = (
-        "Address already in use",
-        "Permission denied",
-        "Failed to connect to coordinator",
-        "Cannot assign requested address",
-    )
-    if proc.returncode != 0 and any(e in out for e in setup_errors):
+    # The rendezvous skip applies only to modes that USE the TCP
+    # coordinator: fleet mode runs independent workers, so a 'Permission
+    # denied' there is a failure of the artifact layout under test, not
+    # an environment capability gap.
+    if (
+        proc.returncode != 0
+        and mode != "fleet"
+        and any(e in out for e in SETUP_ERRORS)
+    ):
         pytest.skip(f"multi-process rendezvous unsupported here: {out[-400:]}")
     assert proc.returncode == 0, out[-2000:]
     assert "AGREE" in out
+    return out
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("mode", ["dp", "tp", "sp", "pp", "ep", "fsdp"])
+def test_two_process_smoke(mode):
+    # Per-mode budget: 2 workers (600s communicate each, overlapping)
+    # plus the tp/sp/pp single-process reference (900s) on a contended
+    # 1-core host.
+    _run_smoke(mode, timeout=1800)
+
+
+def test_two_process_fleet_heartbeats_and_straggler():
+    """ISSUE 7 acceptance, under REAL multi-process (tier-1, no slow
+    marker): a two-process CPU fit produces per-process heartbeat
+    streams, exactly one merged fleet manifest, and a straggler ranking
+    (recomputed offline through tools/fleet_status.py) that names the
+    injected-delay rank. The smoke script carries the assertions; this
+    wrapper pins its AGREE contract."""
+    out = _run_smoke("fleet", timeout=900)
+    assert "straggler" in out

@@ -1,0 +1,254 @@
+#!/usr/bin/env python
+"""Render a run's fleet telemetry: skew, stragglers, gaps, captures.
+
+Reads the ``<log_dir>/fleet/`` artifact layout the trainer's heartbeat
+writer produces (``sav_tpu/obs/fleet.py``, docs/fleet.md):
+
+  proc_<i>.jsonl       per-process heartbeat streams
+  fleet.json           merged fleet manifest (process 0's in-run view)
+  backend_probe.jsonl  startup probe timeline (the bench give-up path)
+
+and re-aggregates the streams offline — the rendered straggler ranking /
+dead-host suspicion always reflects the COMPLETE streams, not the
+partial view process 0 had when it finished. Also lists anomaly-profiler
+captures — the run manifest's ``notes.autoprof`` merged with every
+process's ``autoprof/proc*_captures.jsonl`` sidecar (non-zero processes
+run with a disabled manifest, so the straggler's own trace lives only
+in its sidecar).
+
+Stdlib-only (no jax import): safe to run on a laptop against rsynced
+logs, and safe in the backend-unreachable post-mortem where importing
+jax is exactly what hangs.
+
+Usage:
+  python tools/fleet_status.py runs/deit_s_patch16
+  python tools/fleet_status.py --json runs/deit_s_patch16
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO_ROOT not in sys.path:  # runnable as a script from anywhere
+    sys.path.insert(0, _REPO_ROOT)
+
+# Stdlib-only module (no jax) — the laptop-safety contract holds.
+from sav_tpu.obs.fleet import (  # noqa: E402
+    aggregate_fleet,
+    fleet_dir,
+    read_probe_timeline,
+)
+
+
+def _fmt_unix(t) -> str:
+    if not isinstance(t, (int, float)):
+        return "?"
+    import datetime
+
+    return datetime.datetime.fromtimestamp(t).strftime("%H:%M:%S")
+
+
+def autoprof_captures(log_dir: str) -> list:
+    """Anomaly-profiler captures: the run manifest's ``notes.autoprof``
+    merged with every process's sidecar (``autoprof/proc*_captures.jsonl``
+    — non-zero processes run with a disabled manifest, so the
+    straggler's own trace only exists in its sidecar). Deduplicated by
+    trace path."""
+    captures: list = []
+    path = os.path.join(log_dir, "manifest.json")
+    try:
+        with open(path) as f:
+            doc = json.load(f)
+        noted = (doc.get("notes") or {}).get("autoprof")
+        if isinstance(noted, list):
+            captures.extend(c for c in noted if isinstance(c, dict))
+    except (OSError, json.JSONDecodeError):
+        pass
+    import glob
+
+    for sidecar in sorted(
+        glob.glob(os.path.join(log_dir, "autoprof", "proc*_captures.jsonl"))
+    ):
+        try:
+            with open(sidecar) as f:
+                for line in f:
+                    line = line.strip()
+                    if not line:
+                        continue
+                    try:
+                        captures.append(json.loads(line))
+                    except json.JSONDecodeError:
+                        continue
+        except OSError:
+            continue
+    seen: set = set()
+    unique = []
+    for c in captures:
+        key = c.get("path")
+        if key in seen:
+            continue
+        seen.add(key)
+        unique.append(c)
+    return unique
+
+
+def render(log_dir: str, summary: dict, out) -> None:
+    processes = summary.get("processes") or {}
+    print(f"== Fleet status: {log_dir} ==", file=out)
+    if not processes:
+        print(
+            f"(no heartbeat streams under {fleet_dir(log_dir)} — run with "
+            "fleet telemetry on, or the backend never came up: see the "
+            "probe timeline below, if any)",
+            file=out,
+        )
+    else:
+        print(f"Processes: {len(processes)}", file=out)
+        for proc in sorted(processes, key=int):
+            v = processes[proc]
+            status = (
+                f"final ({v.get('outcome')})" if v.get("final")
+                else "no final record"
+            )
+            med = v.get("median_step_s")
+            stall = v.get("median_host_stall_frac")
+            print(
+                f"  proc {proc} [{v.get('host', '?')}]: "
+                f"{v.get('heartbeats', 0)} heartbeats, last step "
+                f"{v.get('last_step')} at {_fmt_unix(v.get('last_unix'))}, "
+                f"median {med if med is not None else '?'} s/step"
+                + (f", host-stall {stall:.1%}" if stall is not None else "")
+                + f" — {status}",
+                file=out,
+            )
+            if v.get("incident"):
+                print(f"    incident: {v['incident']}", file=out)
+        skew = summary.get("step_skew") or {}
+        if skew:
+            print(
+                f"Step skew: {skew.get('skew', 0)} steps "
+                f"(frontier {skew.get('max_step')}, laggard proc "
+                f"{skew.get('laggard')} at {skew.get('min_step')})",
+                file=out,
+            )
+        timeline = summary.get("skew_timeline") or []
+        if timeline:
+            t0 = timeline[0].get("t", 0.0)
+            tail = timeline[-8:]
+            print(
+                "Skew timeline (tail): "
+                + "  ".join(
+                    f"+{e.get('t', 0.0) - t0:.0f}s p{e.get('proc')}@"
+                    f"{e.get('step')}"
+                    for e in tail
+                ),
+                file=out,
+            )
+        straggler = summary.get("straggler") or {}
+        ranking = straggler.get("ranking") or []
+        if ranking:
+            print("Straggler ranking (leave-one-out median+MAD):", file=out)
+            for entry in ranking:
+                flag = "  <-- STRAGGLER" if entry.get("flagged") else ""
+                host_stall = (entry.get("host_stall") or {}).get("value")
+                step_time = (entry.get("step_time") or {}).get("value")
+                print(
+                    f"  proc {entry['proc']}: score {entry.get('score')}"
+                    + (
+                        f", host-stall {host_stall:.1%}"
+                        if host_stall is not None else ""
+                    )
+                    + (
+                        f", {step_time:.4g} s/step"
+                        if step_time is not None else ""
+                    )
+                    + flag,
+                    file=out,
+                )
+        suspects = summary.get("suspects") or []
+        for s in suspects:
+            print(
+                f"SUSPECT DEAD: proc {s['proc']} stopped heartbeating at "
+                f"step {s.get('last_step')} "
+                f"({_fmt_unix(s.get('last_unix'))}; silent "
+                f"{s.get('silent_s')}s vs median interval "
+                f"{s.get('median_interval_s')}s)",
+                file=out,
+            )
+        events = summary.get("events") or []
+        if events:
+            print(f"Events: {len(events)}", file=out)
+            for e in events[:10]:
+                print(
+                    f"  proc {e.get('proc')} {e.get('event')} at "
+                    f"step {e.get('step')} ({_fmt_unix(e.get('t'))})",
+                    file=out,
+                )
+    probes = read_probe_timeline(log_dir)
+    if probes:
+        attempts = [p for p in probes if p.get("kind") == "probe"]
+        giveups = [p for p in probes if p.get("kind") == "probe_giveup"]
+        print(
+            f"Backend probe timeline: {len(attempts)} probe(s), "
+            f"{len(giveups)} give-up(s)"
+            + (
+                " — the backend never came up (no heartbeats followed)"
+                if not processes else ""
+            ),
+            file=out,
+        )
+        for p in attempts[-5:]:
+            print(
+                f"  attempt {p.get('attempt')}: platform "
+                f"{p.get('platform')} at +{p.get('elapsed_s')}s",
+                file=out,
+            )
+    captures = autoprof_captures(log_dir)
+    if captures:
+        print(f"Autoprof captures: {len(captures)}", file=out)
+        for c in captures:
+            print(
+                f"  {c.get('trigger')} at step {c.get('trigger_step')}: "
+                f"steps {c.get('start_step')}..{c.get('end_step')} -> "
+                f"{c.get('path')}",
+                file=out,
+            )
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter,
+    )
+    parser.add_argument(
+        "log_dir", help="run log dir (the parent of its fleet/ directory)"
+    )
+    parser.add_argument(
+        "--json", action="store_true",
+        help="emit the aggregated fleet summary as JSON",
+    )
+    parser.add_argument(
+        "--straggler-k", type=float, default=3.5,
+        help="leave-one-out MAD threshold (the sentinel's robust cut)",
+    )
+    args = parser.parse_args(argv)
+    if not os.path.isdir(args.log_dir):
+        print(f"fleet_status: no such directory: {args.log_dir}",
+              file=sys.stderr)
+        return 2
+    summary = aggregate_fleet(args.log_dir, straggler_k=args.straggler_k)
+    summary["autoprof"] = autoprof_captures(args.log_dir)
+    summary["probe_timeline"] = read_probe_timeline(args.log_dir)
+    if args.json:
+        print(json.dumps(summary, indent=2, default=str))
+    else:
+        render(args.log_dir, summary, sys.stdout)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

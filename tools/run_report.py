@@ -43,7 +43,12 @@ _REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 if _REPO_ROOT not in sys.path:  # runnable as a script from anywhere
     sys.path.insert(0, _REPO_ROOT)
 
-# Stdlib-only module (no jax) — the laptop-safety contract holds.
+# Stdlib-only modules (no jax) — the laptop-safety contract holds.
+from sav_tpu.obs.fleet import (  # noqa: E402
+    aggregate_fleet,
+    fleet_dir,
+    read_probe_timeline,
+)
 from sav_tpu.obs.manifest import load_run_history  # noqa: E402
 
 
@@ -346,6 +351,73 @@ def report_incidents(log_dir: str, out) -> None:
             )
 
 
+def report_fleet(log_dir: str, out) -> None:
+    """Render the fleet-telemetry summary (docs/fleet.md): per-process
+    heartbeats, step skew, straggler ranking, dead-host suspicion, and
+    the backend-probe timeline. Degrades gracefully — a run with no
+    ``fleet/`` dir (fleet telemetry off, or predating it) reports that
+    instead of erroring."""
+    probes = read_probe_timeline(log_dir)
+    if not os.path.isdir(fleet_dir(log_dir)):
+        print(f"(no fleet directory at {fleet_dir(log_dir)} — run with "
+              "fleet telemetry on)", file=out)
+        return
+    summary = aggregate_fleet(log_dir)
+    processes = summary.get("processes") or {}
+    if not processes:
+        print(
+            f"Fleet: no heartbeat streams under {fleet_dir(log_dir)}"
+            + (
+                f" ({len(probes)} backend-probe records — the backend "
+                "never came up)" if probes else ""
+            ),
+            file=out,
+        )
+        return
+    finals = sum(1 for v in processes.values() if v.get("final"))
+    print(
+        f"Fleet: {len(processes)} process(es), {finals} with final "
+        "records",
+        file=out,
+    )
+    for proc in sorted(processes, key=int):
+        v = processes[proc]
+        med = v.get("median_step_s")
+        print(
+            f"  proc {proc}: {v.get('heartbeats', 0)} heartbeats, last "
+            f"step {v.get('last_step')}"
+            + (f", median {med:g} s/step" if med is not None else "")
+            + ("" if v.get("final") else "  <-- no final record"),
+            file=out,
+        )
+    skew = summary.get("step_skew") or {}
+    if skew.get("skew"):
+        print(
+            f"  step skew: {skew['skew']} (laggard proc "
+            f"{skew.get('laggard')})",
+            file=out,
+        )
+    straggler = (summary.get("straggler") or {}).get("straggler")
+    if straggler is not None:
+        print(f"  STRAGGLER: proc {straggler} (see tools/fleet_status.py "
+              f"{log_dir} for the ranking)", file=out)
+    for s in summary.get("suspects") or []:
+        print(
+            f"  SUSPECT DEAD: proc {s['proc']} stopped heartbeating at "
+            f"step {s.get('last_step')} (silent {s.get('silent_s')}s)",
+            file=out,
+        )
+    for e in summary.get("events") or []:
+        print(
+            f"  event: proc {e.get('proc')} {e.get('event')} at step "
+            f"{e.get('step')}",
+            file=out,
+        )
+    if probes:
+        print(f"  backend-probe timeline: {len(probes)} record(s) "
+              "(fleet/backend_probe.jsonl)", file=out)
+
+
 def report_bench_history(paths: list, out) -> int:
     """Render bench-record history; returns a process exit code (2 on
     unreadable input — mirroring the sentinel's usage/IO contract)."""
@@ -395,6 +467,13 @@ def main(argv=None) -> int:
         "lines, manifests): rendered with infra failures separated",
     )
     parser.add_argument(
+        "--fleet", action="store_true",
+        help="render the log dir's fleet telemetry (heartbeat streams, "
+        "step skew, straggler ranking, dead-host suspicion — "
+        "docs/fleet.md); also rendered automatically when a fleet/ "
+        "directory exists. Degrades gracefully on runs without one.",
+    )
+    parser.add_argument(
         "--incidents", action="store_true",
         help="render the log dir's flight-recorder incident bundles "
         "(<log-dir>/incidents/) with their replay verdicts; incident "
@@ -413,6 +492,10 @@ def main(argv=None) -> int:
         # --bench without a log dir: render the history, just note the
         # flag had nothing to point at instead of aborting the report.
         print("(--incidents ignored: no log dir given)", file=sys.stderr)
+    if args.fleet and args.log_dir is None:
+        if args.bench is None:
+            parser.error("--fleet needs a log dir to look under")
+        print("(--fleet ignored: no log dir given)", file=sys.stderr)
 
     if args.bench:
         rc = report_bench_history(args.bench, sys.stdout)
@@ -457,6 +540,11 @@ def main(argv=None) -> int:
         or os.path.isdir(os.path.join(args.log_dir, "incidents"))
     ):
         report_incidents(args.log_dir, out)
+
+    if args.log_dir and (
+        args.fleet or os.path.isdir(fleet_dir(args.log_dir))
+    ):
+        report_fleet(args.log_dir, out)
 
     if args.log_dir:
         spans = os.path.join(args.log_dir, "spans.trace.json")

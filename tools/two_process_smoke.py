@@ -30,6 +30,20 @@ the numerics.
 
 ``--mode sp`` is the same transposed layout on the ``seq`` axis: the
 ring's K/V ppermute hops cross processes (ring attention multi-host).
+``--mode fleet`` (round 7) exercises the fleet-telemetry layer
+(sav_tpu/obs/fleet.py, docs/fleet.md) under REAL multi-process: two
+worker processes each run a short ``Trainer.fit`` over ONE shared log
+dir with an injected input-side delay on rank 1 (the straggler); the
+parent asserts both processes heartbeat into ``fleet/proc_<i>.jsonl``,
+the merged fleet manifest was written exactly once (fleet process 0),
+and the offline aggregation (``tools/fleet_status.py --json``) ranks
+the injected-delay process as the straggler. Fleet identity comes from
+the ``SAV_FLEET_PROC``/``SAV_FLEET_PROCS`` override — the documented
+seam for fleets not coordinated through ``jax.distributed`` — because
+this leg targets the telemetry layer, which is transport-agnostic by
+design (the dp/tp/... modes own the collective-transport proof, and
+CPU backends without multiprocess computation support must still be
+able to smoke the fleet layer).
 ``--mode pp`` puts the ``pipe`` axis across processes: the GPipe
 stage-boundary activation ppermutes ride the cross-process transport.
 ``--mode ep`` swaps in the MoE ViT with the ``expert`` axis across
@@ -165,6 +179,177 @@ def single_reference(mode: str) -> None:
     )
 
 
+FLEET_STEPS = 8
+FLEET_DELAY_S = 0.25  # rank 1's injected per-step input delay
+
+
+def fleet_worker(rank: int, log_dir: str) -> None:
+    """One fleet-mode worker: a short real fit() with heartbeats on and
+    an injected input-side delay on rank 1 — the straggler pattern the
+    aggregator must attribute (the delay lands in rank 1's input_wait
+    bucket and stretches its heartbeat intervals). Identity comes from
+    SAV_FLEET_PROC/_PROCS set by the parent; the workers are otherwise
+    independent single-process fits sharing one log dir."""
+    import time as _time
+
+    import jax
+    import numpy as np
+
+    from sav_tpu.train import TrainConfig, Trainer
+
+    config = TrainConfig(
+        model_name="vit_ti_patch16",
+        num_classes=10,
+        image_size=32,
+        compute_dtype="float32",
+        global_batch_size=GLOBAL_BATCH,
+        num_train_images=GLOBAL_BATCH * FLEET_STEPS,
+        num_epochs=1,
+        warmup_epochs=0,
+        base_lr=1e-3,
+        transpose_images=False,
+        model_overrides=dict(num_layers=2, embed_dim=64, num_heads=4),
+        log_every_steps=1,
+        log_dir=log_dir,
+        fleet=True,
+        seed=0,
+    )
+    trainer = Trainer(config)
+
+    images, labels = _global_batch()
+
+    def batches():
+        for step in range(FLEET_STEPS):
+            if rank == 1:
+                _time.sleep(FLEET_DELAY_S)  # the injected straggler
+            yield {
+                "images": images,
+                "labels": labels.astype(np.int32),
+            }
+
+    state, history = trainer.fit(batches(), num_steps=FLEET_STEPS)
+    steps = int(jax.device_get(state.step))
+    print(f"RANK {rank} FLEETSTEPS {steps}", flush=True)
+
+
+def _run_fleet() -> int:
+    import glob
+    import json
+    import tempfile
+
+    repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    base_env = dict(os.environ)
+    base_env["PYTHONPATH"] = repo_root + (
+        os.pathsep + base_env["PYTHONPATH"]
+        if base_env.get("PYTHONPATH") else ""
+    )
+    base_env.pop("PALLAS_AXON_POOL_IPS", None)
+    base_env["JAX_PLATFORMS"] = "cpu"
+    base_env["XLA_FLAGS"] = (
+        base_env.get("XLA_FLAGS", "")
+        + f" --xla_force_host_platform_device_count={N_LOCAL_DEVICES}"
+    )
+    log_dir = tempfile.mkdtemp(prefix="sav_fleet_smoke_")
+    base_env["SMOKE_FLEET_LOG_DIR"] = log_dir
+
+    print("=== mode fleet ===", flush=True)
+    procs = []
+    for r in range(NUM_PROCESSES):
+        env = dict(base_env)
+        # The documented non-jax.distributed fleet identity seam
+        # (sav_tpu/obs/fleet.py resolve_identity).
+        env["SAV_FLEET_PROC"] = str(r)
+        env["SAV_FLEET_PROCS"] = str(NUM_PROCESSES)
+        procs.append(
+            subprocess.Popen(
+                [sys.executable, __file__, "--rank", str(r),
+                 "--mode", "fleet"],
+                env=env,
+                stdout=subprocess.PIPE,
+                stderr=subprocess.STDOUT,
+                text=True,
+            )
+        )
+    outs = []
+    ok = True
+    for r, p in enumerate(procs):
+        try:
+            out, _ = p.communicate(timeout=600)
+        except subprocess.TimeoutExpired:
+            p.kill()
+            out, _ = p.communicate()
+            ok = False
+        outs.append(out)
+        print(f"--- rank {r} (rc={p.returncode}) ---\n{out}")
+        ok = ok and p.returncode == 0
+    all_out = "\n".join(outs)
+    if not ok:
+        print("FAIL: fleet workers did not complete")
+        return 1
+    done = [
+        line for line in all_out.splitlines() if "FLEETSTEPS" in line
+    ]
+    if len(done) != NUM_PROCESSES:
+        print(f"FAIL: expected {NUM_PROCESSES} completion lines: {done}")
+        return 1
+
+    # 1. Both processes heartbeated into their own streams.
+    for r in range(NUM_PROCESSES):
+        path = os.path.join(log_dir, "fleet", f"proc_{r}.jsonl")
+        if not os.path.exists(path):
+            print(f"FAIL: no heartbeat stream {path}")
+            return 1
+        with open(path) as f:
+            lines = [json.loads(ln) for ln in f if ln.strip()]
+        beats = [ln for ln in lines if ln.get("kind") == "hb"]
+        finals = [ln for ln in lines if ln.get("kind") == "final"]
+        if len(beats) < FLEET_STEPS or len(finals) != 1:
+            print(
+                f"FAIL: proc {r} stream malformed: {len(beats)} beats, "
+                f"{len(finals)} finals"
+            )
+            return 1
+        if any(b.get("proc") != r for b in beats):
+            print(f"FAIL: proc {r} stream carries wrong proc ids")
+            return 1
+
+    # 2. The merged fleet manifest was written exactly once (process 0).
+    manifests = glob.glob(os.path.join(log_dir, "fleet", "fleet*.json"))
+    if len(manifests) != 1:
+        print(f"FAIL: expected exactly one merged fleet manifest: "
+              f"{manifests}")
+        return 1
+
+    # 3. Offline aggregation (through the CLI) names the injected-delay
+    # process as the straggler.
+    proc = subprocess.run(
+        [
+            sys.executable,
+            os.path.join(repo_root, "tools", "fleet_status.py"),
+            "--json", log_dir,
+        ],
+        capture_output=True, text=True, timeout=120,
+    )
+    if proc.returncode != 0:
+        print(f"FAIL: fleet_status failed: {proc.stderr}")
+        return 1
+    summary = json.loads(proc.stdout)
+    straggler = (summary.get("straggler") or {}).get("straggler")
+    if straggler != 1:
+        print(
+            "FAIL: straggler ranking did not name the injected-delay "
+            f"process: {json.dumps(summary.get('straggler'), indent=2)}"
+        )
+        return 1
+    print(
+        f"AGREE: fleet mode — both processes heartbeated ({FLEET_STEPS}+ "
+        "beats each), one merged fleet manifest, and the offline "
+        "aggregation ranked the injected-delay process (rank 1, "
+        f"+{FLEET_DELAY_S}s/step input stall) as the straggler"
+    )
+    return 0
+
+
 def worker(rank: int, coordinator: str, mode: str) -> None:
     from sav_tpu.parallel import distributed_init
 
@@ -229,35 +414,40 @@ def main() -> int:
     mode = "dp"
     if "--mode" in sys.argv:
         mode = sys.argv[sys.argv.index("--mode") + 1]
-        if mode not in MODE_AXIS:
+        if mode not in MODE_AXIS and mode != "fleet":
             print(
-                f"unknown --mode {mode!r}; known: {sorted(MODE_AXIS)}",
+                f"unknown --mode {mode!r}; known: "
+                f"{sorted(MODE_AXIS) + ['fleet']}",
                 file=sys.stderr,
             )
             return 2
     if "--single" in sys.argv:
-        if MODE_AXIS[mode] is None:
-            print("--single needs --mode tp|sp|pp|ep|fsdp (dp has no reference run)",
+        if mode == "fleet" or MODE_AXIS[mode] is None:
+            print("--single needs --mode tp|sp|pp|ep|fsdp (dp/fleet have "
+                  "no reference run)",
                   file=sys.stderr)
             return 2
         single_reference(mode)
         return 0
     if "--rank" in sys.argv:
         rank = int(sys.argv[sys.argv.index("--rank") + 1])
-        worker(rank, os.environ["SMOKE_COORDINATOR"], mode)
+        if mode == "fleet":
+            fleet_worker(rank, os.environ["SMOKE_FLEET_LOG_DIR"])
+        else:
+            worker(rank, os.environ["SMOKE_COORDINATOR"], mode)
         return 0
     if "--mode" in sys.argv:
         modes = [mode]
     else:
-        modes = ["dp", "tp", "sp", "pp", "ep", "fsdp"]
+        modes = ["dp", "tp", "sp", "pp", "ep", "fsdp", "fleet"]
     for m in modes:
         # bind-then-close port picking races other processes on the host; one
         # retry with a fresh port covers the TOCTOU without masking real bugs
         # (only rendezvous-setup errors trigger it).
-        rc = _run_once(m)
+        rc = _run_fleet() if m == "fleet" else _run_once(m)
         if rc == 2:
             print("retrying once with a fresh coordinator port", flush=True)
-            rc = _run_once(m)
+            rc = _run_fleet() if m == "fleet" else _run_once(m)
         if rc != 0:
             return rc
     return 0

@@ -192,6 +192,39 @@ import click
     "checkpoint gap.",
 )
 @click.option(
+    "--watchdog-soft-secs", type=float, default=None,
+    help="Watchdog soft (warning) stage: when no step completes within "
+    "this many seconds (< --watchdog-secs) dump all thread stacks + a "
+    "fleet-heartbeat event and arm the anomaly profiler, but keep "
+    "running — only the hard deadline aborts (docs/fleet.md).",
+)
+@click.option(
+    "--fleet/--no-fleet", default=True,
+    help="Fleet telemetry (docs/fleet.md): every process appends "
+    "heartbeats (step, goodput buckets, HBM/retraces, incident pointer) "
+    "to <log-dir>/fleet/proc_<i>.jsonl at the log boundary (no extra "
+    "device syncs), and process 0 writes the merged fleet manifest "
+    "(step skew, straggler ranking, dead-host suspicion). Render with "
+    "tools/fleet_status.py or run_report.py --fleet.",
+)
+@click.option(
+    "--autoprof/--no-autoprof", default=False,
+    help="Anomaly-triggered profiling (docs/fleet.md): a goodput stall "
+    "anomaly, a robust step-time spike, or the watchdog's soft stage "
+    "arms jax.profiler for a bounded --autoprof-steps trace under "
+    "<log-dir>/autoprof/, stamped into the run manifest; at most "
+    "--autoprof-max captures per run.",
+)
+@click.option(
+    "--autoprof-steps", type=int, default=4,
+    help="Steps per anomaly-triggered profiler capture window.",
+)
+@click.option(
+    "--autoprof-max", type=int, default=2,
+    help="Per-run budget of anomaly-triggered profiler captures "
+    "(the recorder's max_incidents discipline applied to traces).",
+)
+@click.option(
     "--record/--no-record", default=False,
     help="Flight recorder (docs/incident_replay.md): keep a bounded ring "
     "of the last steps' host-side context (batch hashes + raw batches, "
@@ -323,6 +356,7 @@ def _run(
     eval_only, steps, num_train_images,
     num_eval_images, crop_min_area, train_flip, platform, backend_wait,
     fused_optimizer, log_dir, diagnostics, trace_spans, watchdog_secs,
+    watchdog_soft_secs, fleet, autoprof, autoprof_steps, autoprof_max,
     record, record_depth, record_batches, spike_sigma,
     sanitize, device_preprocess, async_feed, feed_depth,
     compilation_cache_dir, peak_flops, seed,
@@ -350,6 +384,15 @@ def _run(
     from sav_tpu.parallel import distributed_init
     from sav_tpu.train import TrainConfig, Trainer, get_preset
 
+    if watchdog_soft_secs is not None and (
+        watchdog_secs is None or watchdog_soft_secs >= watchdog_secs
+    ):
+        # The soft stage rides the hard watchdog's thread; a soft-only
+        # (or inverted) configuration would silently never warn.
+        raise click.UsageError(
+            "--watchdog-soft-secs needs --watchdog-secs and must be "
+            "smaller than it (soft warns, hard aborts)"
+        )
     if (num_train_images is None) != (num_eval_images is None):
         # Both flags flip the TFRecord reader into custom-dataset mode
         # (0-indexed labels, no VALID carve-out); mixing modes between train
@@ -365,9 +408,14 @@ def _run(
     # from TF as well — both orderings are defended).
     distributed_init()
     n_devices = len(jax.devices())
-    if jax.process_index() != 0:
-        # Multi-host runs share the log dir; only process 0 owns the
-        # manifest file (same rule as the goodput/span writers).
+    from sav_tpu.obs.fleet import resolve_identity as _fleet_identity
+
+    if _fleet_identity(jax.process_index(), jax.process_count())[0] != 0:
+        # Runs share the log dir; only FLEET process 0 owns the manifest
+        # file (same rule as the goodput/span writers). The fleet
+        # identity defaults to jax's process index and honors the
+        # SAV_FLEET_PROC override, so independent workers sharing a log
+        # dir (docs/fleet.md) don't clobber each other's manifest either.
         manifest.disable()
 
     from sav_tpu.data.pipeline import Split, load
@@ -433,6 +481,11 @@ def _run(
         diagnostics=diagnostics,
         trace_spans=trace_spans,
         watchdog_secs=watchdog_secs,
+        watchdog_soft_secs=watchdog_soft_secs,
+        fleet=fleet,
+        autoprof=autoprof,
+        autoprof_steps=autoprof_steps,
+        autoprof_max=autoprof_max,
         record=record,
         record_depth=record_depth,
         record_batches=record_batches,
@@ -468,6 +521,10 @@ def _run(
             "peak_flops": "peak_flops",
             "log_dir": "log_dir", "diagnostics": "diagnostics",
             "trace_spans": "trace_spans", "watchdog_secs": "watchdog_secs",
+            "watchdog_soft_secs": "watchdog_soft_secs",
+            "fleet": "fleet", "autoprof": "autoprof",
+            "autoprof_steps": "autoprof_steps",
+            "autoprof_max": "autoprof_max",
             "record": "record", "record_depth": "record_depth",
             "record_batches": "record_batches",
             "spike_sigma": "spike_sigma",

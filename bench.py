@@ -138,7 +138,8 @@ def _record_window(recorder, step, loss_val, result):
 
 def run(model_name, batch_size, steps, backend, image_size, reps, feed,
         device_preprocess=False, async_feed=True, compilation_cache_dir=None,
-        peak_flops=None, record=False, record_dir=None, attn_tune_cache=None):
+        peak_flops=None, record=False, record_dir=None, attn_tune_cache=None,
+        trace=False):
     import jax
 
     from sav_tpu.data import synthetic_data_iterator
@@ -231,7 +232,7 @@ def run(model_name, batch_size, steps, backend, image_size, reps, feed,
         # cost analysis comes from (AOT .compile() does not populate the jit
         # dispatch cache, so mixing AOT + jit would compile twice).
         with ledger.measure("compile"):
-            step = trainer._train_step.lower(state, sharded, rng).compile()
+            step = trainer.compile_train_step(state, sharded, rng)
         cost = train_step_cost(
             state.params, batch_size=batch_size, image_size=image_size,
             compiled=step, n_devices=len(jax.devices()),
@@ -258,6 +259,85 @@ def run(model_name, batch_size, steps, backend, image_size, reps, feed,
             ledger.note_window(steps, elapsed, step=(rep + 1) * steps)
             windows.append(elapsed / steps)
             _record_window(recorder, (rep + 1) * steps, loss_val, result)
+
+        if trace:
+            # One EXTRA profiled window after the measured ones (profiler
+            # overhead must not pollute `value`), machine-read on the
+            # spot (sav_tpu/obs/traceview.py): the compiled step's HLO
+            # metadata attributes device time onto the cost model's
+            # component keys, and the measured attention-core fraction
+            # rides the JSON line + manifest so the regression sentinel
+            # gates on WHERE the time went, not just how much
+            # (docs/profiling.md).
+            from sav_tpu.obs import traceview
+            from sav_tpu.utils import profiler as _prof
+
+            # `value` is fully measured by now: a capture failure
+            # (unwritable dir, profiler already active, a crash in the
+            # extra window) must degrade to a bench WITHOUT trace
+            # fields, never destroy the measurement (see except below).
+            # Fresh per-run subdirectory: runs/bench/trace accumulates
+            # captures across invocations, and an empty capture (the
+            # failure the `if traces:` guard exists for) must read as
+            # "no trace", never as a PRIOR run's trace summarized under
+            # THIS run's op index and stamped into its sentinel record.
+            trace_dir = os.path.join(
+                record_dir or "runs/bench", "trace",
+                f"{time.strftime('%Y%m%d-%H%M%S')}-{os.getpid()}",
+            )
+            try:
+                op_index = traceview.parse_hlo_op_index(step.as_text())
+                jax.block_until_ready(state)
+                _prof.start_trace(trace_dir)
+                try:
+                    for _ in range(steps):
+                        state, metrics = step(state, sharded, rng)
+                    float(jax.device_get(metrics["loss"]))
+                finally:
+                    _prof.stop_trace()
+                traces = traceview.find_traces(trace_dir)
+                if traces:
+                    traceview.save_op_index(
+                        os.path.join(
+                            os.path.dirname(traces[-1]), "op_index.json"
+                        ),
+                        op_index,
+                    )
+                    summary = traceview.summarize(
+                        traces[-1], op_index=op_index,
+                        predicted=cost.attribution, steps=steps,
+                    )
+                    # Same artifact contract as autoprof captures: the
+                    # full summary next to the trace, so run_report
+                    # --trace and trace_report discover it offline.
+                    try:
+                        with open(
+                            os.path.join(
+                                os.path.dirname(traces[-1]),
+                                "trace_summary.json",
+                            ),
+                            "w",
+                        ) as f:
+                            json.dump(summary, f, indent=2)
+                    except OSError:
+                        pass
+                    acf = summary.get("attention_core_frac")
+                    result["trace"] = {
+                        "path": traces[-1],
+                        "per_step_ms": summary.get("per_step_ms"),
+                        "idle_frac": summary.get("idle_frac"),
+                        "indexed_frac": summary.get("indexed_frac"),
+                        "components_frac": summary.get(
+                            "components_frac"
+                        ),
+                        "disagrees": (
+                            summary.get("vs_predicted") or {}
+                        ).get("disagrees", []),
+                    }
+                    if acf is not None:
+                        result["attention_core_frac"] = round(acf, 4)
+            except Exception as e:
+                result["trace_error"] = repr(e)[:300]
     else:
         import tempfile
 
@@ -408,6 +488,10 @@ def run(model_name, batch_size, steps, backend, image_size, reps, feed,
     result["_manifest_metrics"] = {
         "value": round(batch_size / best / n_chips, 1),
         **ledger.flat_metrics(),
+        **(
+            {"attention_core_frac": result["attention_core_frac"]}
+            if "attention_core_frac" in result else {}
+        ),
     }
     return batch_size / best / n_chips, n_chips, result
 
@@ -528,6 +612,15 @@ def main(argv=None):
         "(docs/incident_replay.md)",
     )
     parser.add_argument(
+        "--trace", action="store_true",
+        help="capture one extra profiled window AFTER the measured ones "
+        "and machine-read it (sav_tpu/obs/traceview.py): per-layer-group "
+        "device-time attribution vs the cost model, with the measured "
+        "attention-core fraction in the JSON line + manifest so the "
+        "regression sentinel gates on where time went (synthetic feed "
+        "only — the fed loops have no AOT executable to index)",
+    )
+    parser.add_argument(
         "--manifest", default=None,
         help="run-manifest path (sav_tpu/obs/manifest.py): written at "
         "start, finalized with a machine-readable outcome on every exit "
@@ -541,6 +634,12 @@ def main(argv=None):
         args.manifest = os.path.join(
             "runs", "bench",
             f"manifest-{time.strftime('%Y%m%d-%H%M%S')}-{os.getpid()}.json",
+        )
+    if args.trace and args.feed != "synthetic":
+        parser.error(
+            "--trace needs the synthetic feed: attribution reads the AOT "
+            "executable's HLO metadata, and only the synthetic loop runs "
+            "one"
         )
     if args.device_preprocess and args.feed == "synthetic":
         parser.error(
@@ -573,6 +672,7 @@ def main(argv=None):
             record=args.record,
             record_dir=os.path.dirname(args.manifest) or "runs/bench",
             attn_tune_cache=args.attn_tune_cache,
+            trace=args.trace,
         )
     except BaseException as e:
         # Every exit path stays parseable: classify (oom/error/...), put
@@ -624,6 +724,8 @@ def main(argv=None):
     notes = {"metric": out["metric"], "platform": out["platform"]}
     if extra.get("attention_dispatch"):
         notes["attention_dispatch"] = extra["attention_dispatch"]
+    if extra.get("trace"):
+        notes["trace"] = extra["trace"]
     if extra.get("incident"):
         notes["incident"] = extra["incident"]
     manifest.finalize(

@@ -981,6 +981,72 @@ class FleetHotPathSync(Rule):
                 )
 
 
+# ---------------------------------------------------------------- SAV113
+
+
+class ProfilerInHotPath(Rule):
+    """``jax.profiler`` / memory-forensics calls in the training hot path.
+
+    The profiling contract (docs/profiling.md) is that capture happens
+    through the *armed windows* — the edge-synced static window
+    (``TrainConfig.profile_dir``), autoprof's bounded anomaly captures,
+    the OOM incident path — never ad hoc inside the hot loop. A stray
+    ``start_trace``/``stop_trace`` serializes dispatch and bloats the
+    trace ring on every step; ``save_device_memory_profile`` /
+    ``live_arrays`` walk every live buffer; ``dump_memory_incident``
+    writes a forensics bundle. All are incident/window machinery, and in
+    ``fit()``/``evaluate()``/the step impls they are a steady-state tax
+    that the telemetry guards (<1-2% overhead contracts) cannot see
+    statically. The sanctioned sites — the static window's edges, the
+    OOM dump in fit's finally — carry justification pragmas.
+    """
+
+    id = "SAV113"
+    name = "profiler-in-hot-path"
+    severity = "error"
+    hint = (
+        "capture through the armed windows (TrainConfig.profile_dir, "
+        "autoprof's anomaly captures) or the incident path; a sanctioned "
+        "window-edge/incident call carries a justification pragma"
+    )
+
+    PROFILER_CALLS = {
+        "jax.profiler.start_trace": "jax.profiler.start_trace",
+        "jax.profiler.stop_trace": "jax.profiler.stop_trace",
+        "jax.profiler.trace": "jax.profiler.trace window",
+        "jax.profiler.save_device_memory_profile":
+            "device-memory pprof dump",
+        "jax.profiler.device_memory_profile": "device-memory profile",
+        "jax.live_arrays": "live-buffer walk",
+        "sav_tpu.utils.profiler.start_trace": "profiler.start_trace",
+        "sav_tpu.utils.profiler.stop_trace": "profiler.stop_trace",
+        "sav_tpu.utils.profiler.trace": "profiler trace window",
+        "sav_tpu.obs.memdump.dump_memory_incident":
+            "memory-forensics dump",
+        "sav_tpu.obs.memdump.live_buffer_ranking": "live-buffer ranking",
+        "sav_tpu.obs.memdump.live_bytes_total": "live-buffer walk",
+        "sav_tpu.obs.memdump.save_device_memory_profile":
+            "device-memory pprof dump",
+    }
+
+    def check(self, module):
+        for fn in module.functions:
+            if fn.name not in HOT_FUNCTIONS:
+                continue
+            for node in _walk_excluding_nested(fn):
+                if not isinstance(node, ast.Call):
+                    continue
+                resolved = module.resolve_call(node)
+                if resolved in self.PROFILER_CALLS:
+                    yield _finding(
+                        self,
+                        node,
+                        f"{self.PROFILER_CALLS[resolved]} in {fn.name}() "
+                        "— profiling/forensics belong to the armed "
+                        "windows or the incident path, not the hot loop",
+                    )
+
+
 # ----------------------------------------------------------- SAV100 (meta)
 
 
@@ -1044,6 +1110,7 @@ ALL_RULES = [
     AdhocSeedDerivation(),
     RecorderHotLoopSync(),
     FleetHotPathSync(),
+    ProfilerInHotPath(),
 ]
 
 

@@ -75,6 +75,8 @@ class AutoProfiler:
         manifest=None,
         start_fn: Optional[Callable[[str], None]] = None,
         stop_fn: Optional[Callable[[], None]] = None,
+        analyze: bool = True,
+        op_index_fn: Optional[Callable[[], Optional[dict]]] = None,
     ):
         if trace_steps < 1:
             raise ValueError(f"trace_steps must be >= 1, got {trace_steps}")
@@ -92,6 +94,15 @@ class AutoProfiler:
         self.manifest = manifest
         self._start_fn = start_fn
         self._stop_fn = stop_fn
+        # Post-capture trace intelligence (obs/traceview.py): parse the
+        # capture's own trace, attribute op time onto the cost model's
+        # component keys, and ride the summary on the capture record.
+        # op_index_fn lazily yields {hlo op -> metadata scope} (the
+        # trainer derives it from the compiled step); predicted is the
+        # cost model's attribution for the measured-vs-predicted table.
+        self.analyze = analyze
+        self._op_index_fn = op_index_fn
+        self._predicted: Optional[dict] = None
         self._lock = threading.Lock()
         self._armed: Optional[dict] = None     # {trigger, step} pending
         self._active: Optional[dict] = None    # capture in flight
@@ -156,6 +167,11 @@ class AutoProfiler:
         if self.request("step_time_spike", step):
             return "step_time_spike"
         return None
+
+    def set_predicted(self, attribution: Optional[dict]) -> None:
+        """Install the cost model's component attribution (the predicted
+        side of every capture's measured-vs-predicted table)."""
+        self._predicted = dict(attribution) if attribution else None
 
     # --------------------------------------------------------- state machine
 
@@ -232,8 +248,23 @@ class AutoProfiler:
                 "path": active["path"],
                 "t_unix": round(time.time(), 3),
             }
-            self.captures.append(capture)
             self._last_end_step = int(step)
+        if self.analyze:
+            # Bounded post-capture side work (at most max_captures times
+            # per run, off the steady-state path): machine-read the trace
+            # this capture just wrote so the sidecar/manifest carry a
+            # per-layer-group summary instead of a blob pointer. Analysis
+            # failure counts as an error, never unwinds the run, and the
+            # capture record still lands without its summary.
+            try:
+                summary = self._analyze_capture(capture)
+                if summary is not None:
+                    capture["summary"] = summary
+            except Exception:
+                with self._lock:
+                    self.errors += 1
+        with self._lock:
+            self.captures.append(capture)
             captures = list(self.captures)
         # Per-process sidecar FIRST: in a multi-host run every non-zero
         # process carries a DISABLED run manifest (process 0 owns
@@ -254,6 +285,54 @@ class AutoProfiler:
                 self.manifest.note("autoprof", captures)
             except Exception:
                 pass
+
+    def _analyze_capture(self, capture: dict) -> Optional[dict]:
+        """Run traceview over this capture's own trace files.
+
+        Writes ``op_index.json`` + ``trace_summary.json`` into the
+        capture dir (the offline tools' inputs) and returns a trimmed
+        summary for the sidecar/manifest record. Stdlib-only imports —
+        traceview never touches jax.
+        """
+        from sav_tpu.obs import traceview
+
+        traces = traceview.find_traces(capture["path"])
+        if not traces:
+            return None
+        op_index = None
+        if self._op_index_fn is not None:
+            op_index = self._op_index_fn()
+            if op_index:
+                traceview.save_op_index(
+                    os.path.join(capture["path"], "op_index.json"), op_index
+                )
+        summary = traceview.summarize(
+            traces[-1],
+            op_index=op_index,
+            predicted=self._predicted,
+            # The window's step count is known exactly — the trace's own
+            # step markers are a cross-check, not the source of truth.
+            steps=max(capture["end_step"] - capture["start_step"], 1),
+        )
+        try:
+            with open(
+                os.path.join(capture["path"], "trace_summary.json"), "w"
+            ) as f:
+                json.dump(summary, f, indent=2)
+        except OSError:
+            pass
+        trimmed = {
+            "per_step_ms": summary.get("per_step_ms"),
+            "idle_frac": summary.get("idle_frac"),
+            "indexed_frac": summary.get("indexed_frac"),
+            "device_selector": summary.get("device_selector"),
+            "components_frac": summary.get("components_frac"),
+            "attention_core_frac": summary.get("attention_core_frac"),
+        }
+        vs = summary.get("vs_predicted")
+        if vs is not None:
+            trimmed["disagrees"] = vs.get("disagrees", [])
+        return trimmed
 
     def finalize(self, step: Optional[int] = None) -> None:
         """Stop an in-flight capture (fit()'s finally): a crash inside

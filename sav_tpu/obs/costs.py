@@ -167,6 +167,29 @@ def infer_num_tokens(params: Any, image_size: int) -> int:
     return max(image_size // 16, 1) ** 2 + 1
 
 
+def param_group_bytes(params: Any) -> dict[str, float]:
+    """Shape-derived parameter bytes per layer group (+ ``_total``).
+
+    The predicted side of memory forensics (obs/memdump.py): the live
+    ``params``-class buffer total should match this; a gap is a
+    param-shaped buffer the state no longer owns (donation leak) or a
+    dtype drift. Groups are diagnostics' ``_group_of`` naming — the same
+    keys as :class:`StepCost.groups` and ``grad_norm/<group>``.
+    """
+    import jax
+
+    out: dict[str, float] = {}
+    total = 0.0
+    for path, leaf in jax.tree_util.tree_flatten_with_path(params)[0]:
+        _, group, shape, itemsize = _leaf_info(path, leaf)
+        nbytes = float(np.prod(shape)) * itemsize if shape else float(itemsize)
+        out[group] = out.get(group, 0.0) + nbytes
+        total += nbytes
+    out = dict(sorted(out.items()))
+    out["_total"] = total
+    return out
+
+
 def _component_of(joined: str, group: str, shape: tuple) -> str:
     top = group.lower()
     if top == "head" or top.startswith("head"):

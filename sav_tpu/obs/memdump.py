@@ -1,0 +1,330 @@
+"""Memory forensics — make OOM a debuggable incident.
+
+``oom`` has been a manifest *outcome* since PR 4, but an outcome with
+zero forensics: the run died, the allocator said RESOURCE_EXHAUSTED, and
+nothing recorded **what was resident**. This module is the memory twin
+of the flight recorder's incident bundles:
+
+- :class:`HbmWatermark` — the run's peak device-memory occupancy,
+  observed at the trainer's existing log boundaries
+  (``device.memory_stats()`` is a host-side PJRT counter read — no
+  device sync) and stamped into the run manifest as a first-class
+  field (``metrics.hbm_peak_bytes``) on every exit path, so OOM
+  post-mortems and the regression sentinel see the watermark without
+  the goodput file. CPU backends report no memory stats; the finalize
+  pass falls back to one ``jax.live_arrays()`` walk (labeled
+  ``live-arrays``) so the plumbing stays assertable in tier-1.
+- :func:`live_buffer_ranking` — every live device buffer, classified
+  against the training state (``params`` / ``opt_state`` /
+  ``batch_stats`` by buffer identity; everything else is
+  ``unattributed`` — activations, placed batches, donation leaks) and
+  ranked by size. The classes sum against the cost model's per-group
+  parameter-byte estimates (:func:`sav_tpu.obs.costs.param_group_bytes`),
+  so "params grew" reads differently from "something unattributed is
+  eating HBM".
+- :func:`dump_memory_incident` — on any ``oom``-classified exception,
+  write an incident bundle under the recorder's ``incidents/`` layout
+  and budget discipline: ``memdump.json`` (snapshot + watermark +
+  ranking + per-group estimates), plus a
+  ``jax.profiler.save_device_memory_profile`` pprof when the backend
+  supports one. Dumping is telemetry: every path is
+  exception-contained, and a failed dump never outruns the OOM it is
+  documenting.
+
+Rendered by ``tools/run_report.py`` (incidents section) and cross-linked
+from the manifest (``notes.memdump``). docs/profiling.md documents the
+bundle layout.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+from typing import Any, Optional
+
+MEMDUMP_SCHEMA = 1
+
+# Buffer classes in the ranking. 'unattributed' is the interesting one:
+# live buffers that are not the training state — activations held by
+# in-flight dispatches, placed batches, and (the classic leak) buffers
+# kept alive by a stray host reference after donation.
+CLASSES = ("params", "opt_state", "batch_stats", "unattributed")
+
+
+class HbmWatermark:
+    """Running peak of device bytes in use.
+
+    ``observe()`` at log boundaries (host-side counter read, cheap, no
+    sync); ``finalize()`` once in fit's finally — it backfills from a
+    single ``jax.live_arrays()`` walk when the backend never reported
+    memory stats (CPU), so the manifest field exists on every backend.
+    """
+
+    def __init__(self):
+        self.peak_bytes = 0.0
+        self.in_use_bytes = 0.0
+        self.limit_bytes: Optional[float] = None
+        self.source: Optional[str] = None
+        self.samples = 0
+
+    def observe(self, stats: Optional[dict] = None) -> None:
+        """Fold one ``hbm_stats()`` sample in (callers that already hold
+        the dict pass it; otherwise it is read here)."""
+        if stats is None:
+            from sav_tpu.obs.memory import hbm_stats
+
+            try:
+                stats = hbm_stats()
+            except Exception:
+                return
+        if not stats:
+            return
+        self.samples += 1
+        self.source = "device-stats"
+        # hbm_stats() units differ per key: in_use/limit are SUMS over
+        # local devices, peak is the MAX over devices — the OOM-relevant
+        # number on a symmetric mesh. Never fold the summed in_use into
+        # the per-device peak: on a 4-device host that would report 4x
+        # the real per-device occupancy and drown the one device
+        # transiently brushing its limit. Only when the backend reports
+        # no peak counter at all does the sum stand in (degraded,
+        # better than zero).
+        self.in_use_bytes = float(stats.get("hbm_bytes_in_use", 0.0))
+        per_device_peak = float(stats.get("hbm_peak_bytes", 0.0))
+        self.peak_bytes = max(
+            self.peak_bytes, per_device_peak or self.in_use_bytes
+        )
+        limit = stats.get("hbm_bytes_limit")
+        if limit:
+            self.limit_bytes = float(limit)
+
+    def finalize(self) -> dict:
+        """Final watermark record for the manifest. One more device-stats
+        read (the peak may have moved since the last log boundary); when
+        the backend never reported, one live-arrays walk stands in."""
+        self.observe()
+        if self.samples == 0:
+            live = live_bytes_total()
+            if live is not None:
+                self.peak_bytes = max(self.peak_bytes, live)
+                self.in_use_bytes = live
+                self.source = "live-arrays"
+        return self.as_dict()
+
+    def as_dict(self) -> dict:
+        return {
+            "peak_bytes": self.peak_bytes,
+            "in_use_bytes": self.in_use_bytes,
+            "limit_bytes": self.limit_bytes,
+            "source": self.source,
+            "samples": self.samples,
+        }
+
+
+def live_bytes_total() -> Optional[float]:
+    """Total bytes of all live jax arrays (host-side aval metadata —
+    no device read); None when jax is unavailable or the walk fails."""
+    try:
+        import jax
+
+        return float(
+            sum(getattr(x, "nbytes", 0) or 0 for x in jax.live_arrays())
+        )
+    except Exception:
+        return None
+
+
+def _state_buffer_ids(state: Any) -> dict[int, tuple[str, str]]:
+    """``id(buffer) -> (class, layer group)`` over a TrainState's trees.
+
+    Identity, not equality: the ranking must attribute the *actual live
+    buffers* — a donated-then-leaked copy of a param is exactly what
+    must NOT read as 'params'.
+    """
+    import jax
+
+    from sav_tpu.obs.diagnostics import _group_of
+
+    out: dict[int, tuple[str, str]] = {}
+
+    def fold(tree, cls, grouped: bool):
+        if tree is None:
+            return
+        for path, leaf in jax.tree_util.tree_flatten_with_path(tree)[0]:
+            if hasattr(leaf, "nbytes"):
+                out[id(leaf)] = (cls, _group_of(path) if grouped else None)
+
+    fold(getattr(state, "params", None), "params", True)
+    # Opt-state paths mirror the params tree somewhere below wrapper
+    # nodes (or not at all under the fused flat-buffer optimizer), so
+    # the class is the honest granularity here.
+    fold(getattr(state, "opt_state", None), "opt_state", False)
+    fold(getattr(state, "batch_stats", None), "batch_stats", False)
+    return out
+
+
+def live_buffer_ranking(
+    state: Any = None, *, limit: int = 20
+) -> Optional[dict]:
+    """Rank live device buffers by size, classified against ``state``.
+
+    Aggregates by (class, shape, dtype) — an OOM dump with 200 identical
+    activation buffers should read as one row with count 200. Returns
+    None when jax is unavailable (never raises: this runs inside an OOM
+    handler).
+    """
+    try:
+        import jax
+
+        arrays = jax.live_arrays()
+    except Exception:
+        return None
+    known = _state_buffer_ids(state) if state is not None else {}
+    rows: dict[tuple, dict] = {}
+    class_bytes = {c: 0.0 for c in CLASSES}
+    total = 0.0
+    for x in arrays:
+        nbytes = float(getattr(x, "nbytes", 0) or 0)
+        total += nbytes
+        cls, group = known.get(id(x), ("unattributed", None))
+        class_bytes[cls] = class_bytes.get(cls, 0.0) + nbytes
+        key = (cls, group, tuple(getattr(x, "shape", ())),
+               str(getattr(x, "dtype", "?")))
+        row = rows.get(key)
+        if row is None:
+            rows[key] = {
+                "class": cls,
+                "group": group,
+                "shape": list(key[2]),
+                "dtype": key[3],
+                "bytes": nbytes,
+                "count": 1,
+            }
+        else:
+            row["bytes"] += nbytes
+            row["count"] += 1
+    ranking = sorted(rows.values(), key=lambda r: -r["bytes"])
+    return {
+        "total_bytes": total,
+        "num_buffers": len(arrays),
+        "class_bytes": class_bytes,
+        "buffers": ranking[:limit],
+        "truncated": max(0, len(ranking) - limit),
+    }
+
+
+def save_device_memory_profile(path: str) -> bool:
+    """``jax.profiler.save_device_memory_profile`` → pprof, backend
+    permitting; False (never an exception) otherwise."""
+    try:
+        import jax
+
+        jax.profiler.save_device_memory_profile(path)
+        return os.path.exists(path)
+    except Exception:
+        return False
+
+
+def _existing_dumps(log_dir: str) -> list[str]:
+    root = os.path.join(log_dir, "incidents")
+    if not os.path.isdir(root):
+        return []
+    return sorted(
+        d for d in os.listdir(root)
+        if d.startswith("memdump_")
+        and os.path.isdir(os.path.join(root, d))
+    )
+
+
+def dump_memory_incident(
+    log_dir: str,
+    *,
+    trigger: str = "oom",
+    step: Optional[int] = None,
+    error: Optional[str] = None,
+    state: Any = None,
+    watermark: Optional[HbmWatermark] = None,
+    cost=None,
+    manifest=None,
+    max_dumps: int = 2,
+    limit: int = 20,
+) -> Optional[str]:
+    """Write one memory-forensics bundle under ``<log_dir>/incidents/``.
+
+    Budgeted like the flight recorder's incidents (``max_dumps`` per log
+    dir — an OOM loop under a supervisor restart must not fill the
+    disk). Returns the bundle path, or None when the budget is spent or
+    anything failed — this runs on the way out of an OOM and must never
+    replace the real traceback with its own.
+    """
+    try:
+        if len(_existing_dumps(log_dir)) >= max_dumps:
+            return None
+        bundle = os.path.join(
+            log_dir, "incidents", f"memdump_{int(step or 0):08d}"
+        )
+        if os.path.isdir(bundle):
+            bundle = f"{bundle}-{int(time.time())}"
+            if os.path.isdir(bundle):
+                return None
+        os.makedirs(bundle, exist_ok=True)
+        from sav_tpu.obs.memory import hbm_stats
+
+        try:
+            hbm = hbm_stats()
+        except Exception:
+            hbm = {}
+        group_bytes = None
+        if state is not None and getattr(state, "params", None) is not None:
+            try:
+                from sav_tpu.obs.costs import param_group_bytes
+
+                group_bytes = param_group_bytes(state.params)
+            except Exception:
+                group_bytes = None
+        pprof_path = os.path.join(bundle, "memory.pprof")
+        doc = {
+            "schema": MEMDUMP_SCHEMA,
+            "trigger": trigger,
+            "step": step,
+            "error": error,
+            "created_unix": round(time.time(), 3),
+            "hbm": hbm,
+            # finalize(), not as_dict(): the dump runs before fit's own
+            # finally-stamp, and on CPU the live-arrays backfill is the
+            # only nonzero watermark there is.
+            "watermark": watermark.finalize() if watermark is not None
+            else None,
+            "live": live_buffer_ranking(state, limit=limit),
+            # The cost model's shape-derived per-group parameter bytes:
+            # the predicted side the live 'params' class is read against
+            # (divergence = a param-shaped buffer the state no longer
+            # owns, i.e. a donation leak).
+            "param_group_bytes": group_bytes,
+            "cost_model": {
+                "flops_per_device": getattr(cost, "flops", None),
+                "bytes_accessed": getattr(cost, "bytes_accessed", None),
+                "source": getattr(cost, "source", None),
+            } if cost is not None else None,
+            "pprof": save_device_memory_profile(pprof_path),
+        }
+        tmp = os.path.join(bundle, "memdump.json.tmp")
+        with open(tmp, "w") as f:
+            json.dump(doc, f, indent=2, default=str)
+        os.replace(tmp, os.path.join(bundle, "memdump.json"))
+    except Exception as e:
+        import sys
+
+        print(f"memdump: incident dump failed: {e!r}", file=sys.stderr)
+        return None
+    if manifest is not None:
+        try:
+            manifest.note("memdump", {
+                "path": bundle,
+                "trigger": trigger,
+                "step": step,
+            })
+        except Exception:
+            pass
+    return bundle

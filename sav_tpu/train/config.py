@@ -215,6 +215,17 @@ class TrainConfig:
     # only; 0 disables). Armed after 8 healthy windows so early-training
     # noise cannot false-fire.
     spike_sigma: float = 6.0
+    # Memory forensics (sav_tpu.obs.memdump; docs/profiling.md): on an
+    # oom-classified exception, dump an incident bundle under
+    # <log_dir>/incidents/memdump_<step>/ — live-buffer ranking
+    # classified against the training state, HBM snapshot + watermark,
+    # per-group parameter-byte estimates, and a device-memory pprof
+    # where the backend supports one. Steady-state cost is a host-side
+    # memory_stats() counter read per log boundary (the HBM watermark,
+    # stamped into the manifest on every exit path regardless of this
+    # knob). On by default: forensics only run when the run is already
+    # dead.
+    memdump: bool = True
     # Runtime sanitizers (sav_tpu.analysis.sanitize;
     # docs/static_analysis.md): after the first completed step, arm
     # jax.transfer_guard_host_to_device("disallow") on the training

@@ -715,6 +715,20 @@ class Trainer:
         """
         return self._train_step(state, placed, rng)
 
+    def compile_train_step(self, state: TrainState, placed: dict, rng):
+        """AOT-lower + compile the train step for an already-placed batch.
+
+        Public surface for harnesses that run the compiled executable
+        directly and read its artifacts — XLA cost analysis (bench.py's
+        MFU), HLO metadata for trace attribution
+        (tools/profile_step.py's op index) — instead of poking the
+        private ``_train_step``. Same program as
+        :meth:`train_step_placed`; note AOT compilation does not
+        populate the jit dispatch cache, so mixing the two pays a second
+        compile.
+        """
+        return self._train_step.lower(state, placed, rng).compile()
+
     def eval_step(self, state: TrainState, batch: dict):
         return self._eval_step(state, self.shard_batch(batch))
 
@@ -966,14 +980,55 @@ class Trainer:
                 process_count=fleet_procs,
             )
         autoprof = None
+        # Abstract (ShapeDtypeStruct) mirror of the step's arguments,
+        # captured once the first real shapes are known — the lazy HLO
+        # op-index source for post-capture trace attribution below.
+        autoprof_abstract = None
         if cfg.autoprof and obs_dir is not None:
             # Anomaly-triggered bounded jax.profiler windows
             # (sav_tpu.obs.autoprof): armed by the ledger's stall
             # anomaly, the per-window step-time spike gate, or the
             # watchdog's soft stage; per-process (a straggler diagnosis
             # needs the straggler's own trace), capture-budgeted like
-            # the recorder's incidents.
+            # the recorder's incidents. Each finished capture is
+            # machine-read on the spot (obs/traceview.py): op time
+            # attributed onto the cost model's component keys via the
+            # compiled step's HLO metadata, summary onto the sidecar +
+            # manifest (docs/profiling.md).
             from sav_tpu.obs.autoprof import AutoProfiler
+
+            _op_index_memo: list = []
+
+            def _autoprof_op_index():
+                # {hlo op -> metadata scope} from the step's compiled
+                # HLO. The AOT executable's text is free; the jit path
+                # lowers+compiles once from the abstract shapes —
+                # bounded post-capture side work (runs at most once per
+                # fit, only after an anomaly capture actually finished),
+                # never steady-state. Memoized including failure: a
+                # backend that cannot re-lower should not retry per
+                # capture.
+                if _op_index_memo:
+                    return _op_index_memo[0]
+                index = None
+                try:
+                    from sav_tpu.obs.traceview import parse_hlo_op_index
+
+                    if compiled_step is not None:
+                        text = compiled_step.as_text()
+                    elif autoprof_abstract is not None:
+                        a_state, a_batch, a_rng = autoprof_abstract
+                        text = self._train_step.lower(
+                            a_state, a_batch, a_rng
+                        ).compile().as_text()
+                    else:
+                        text = None
+                    if text:
+                        index = parse_hlo_op_index(text)
+                except Exception:
+                    index = None
+                _op_index_memo.append(index)
+                return index
 
             autoprof = AutoProfiler(
                 obs_dir,
@@ -981,6 +1036,7 @@ class Trainer:
                 max_captures=cfg.autoprof_max,
                 process_index=fleet_proc,
                 manifest=manifest,
+                op_index_fn=_autoprof_op_index,
             )
         watchdog = None
         if cfg.watchdog_secs:
@@ -1034,6 +1090,19 @@ class Trainer:
         publish_cost_gauges(
             ledger, cost, peak_flops=peak_flops, peak_source=peak_source
         )
+        if autoprof is not None:
+            # The predicted side of every capture's measured-vs-predicted
+            # attribution table (attribution stays analytic even when the
+            # AOT path upgrades the total — same keys either way).
+            autoprof.set_predicted(cost.attribution)
+        # HBM watermark (sav_tpu.obs.memdump): peak device occupancy,
+        # observed at log boundaries (host-side counter read, no sync)
+        # and stamped into the manifest as a first-class field in the
+        # finally — OOM post-mortems and the sentinel read it without
+        # the goodput file.
+        from sav_tpu.obs.memdump import HbmWatermark
+
+        watermark = HbmWatermark()
         if manifest is not None:
             device0 = jax.devices()[0]
             manifest.note("backend", {
@@ -1149,11 +1218,11 @@ class Trainer:
                     # steps, not a few ms of host dispatch.
                     if not profiling and prof_start <= step < prof_stop:
                         jax.block_until_ready(state)  # savlint: disable=SAV101 -- profiler window edge: trace must cover exactly the intended steps
-                        profiler.start_trace(cfg.profile_dir)
+                        profiler.start_trace(cfg.profile_dir)  # savlint: disable=SAV113 -- THE armed static window opening (profile_dir), gated to its configured edge
                         profiling = True
                     elif profiling and step >= prof_stop:
                         jax.block_until_ready(state)  # savlint: disable=SAV101 -- profiler window edge: trace must cover exactly the intended steps
-                        profiler.stop_trace()
+                        profiler.stop_trace()  # savlint: disable=SAV113 -- THE armed static window closing at its configured edge
                         profiling = False
                 if feeder is not None:
                     # Placed batches arrive ready; the only critical-path
@@ -1244,6 +1313,18 @@ class Trainer:
                 else:
                     window_s += dispatch_s
                 if step == start_step:
+                    if autoprof is not None and autoprof_abstract is None:
+                        # Shapes of the step's arguments (host metadata
+                        # only — no buffer retention of the donated
+                        # state): the lazy HLO op-index source when the
+                        # jit path has no AOT executable to read.
+                        autoprof_abstract = jax.tree.map(
+                            lambda x: jax.ShapeDtypeStruct(
+                                x.shape, x.dtype,
+                                sharding=getattr(x, "sharding", None),
+                            ),
+                            (state, sharded, rng),
+                        )
                     if retraces is not None:
                         # The first dispatch's trace is expected
                         # compilation, not a re-trace; swallow it so
@@ -1297,9 +1378,16 @@ class Trainer:
                         # Host-side telemetry sampled only at log boundaries:
                         # HBM occupancy ({} on backends without memory_stats)
                         # and silent-recompilation detection.
-                        m.update(hbm_stats())
+                        hbm = hbm_stats()
+                        m.update(hbm)
+                        watermark.observe(hbm)
                         if retraces is not None:
                             m["retraces"] = float(retraces.delta())
+                    else:
+                        # The watermark samples regardless of diagnostics
+                        # (a host-side counter read — no device sync; {}
+                        # on CPU, backfilled once at finalize).
+                        watermark.observe()
                     t_last = now
                     last_logged_step = step + 1
                     history.append(m)
@@ -1421,6 +1509,27 @@ class Trainer:
                     )
                 for k, v in recorder.stats().items():
                     ledger.set_gauge(f"recorder/{k}", v)
+            if cfg.memdump and obs_dir is not None:
+                # Memory forensics on allocator exhaustion
+                # (sav_tpu.obs.memdump, docs/profiling.md): the state is
+                # still live HERE — by the time train.py's handler
+                # classifies the exception the buffers are gone, so the
+                # live-buffer ranking must be taken on the way out.
+                exc = sys.exc_info()[1]
+                if exc is not None and not isinstance(exc, StopIteration):
+                    from sav_tpu.obs.manifest import classify_exception
+                    from sav_tpu.obs.memdump import dump_memory_incident
+
+                    if classify_exception(exc) == "oom":
+                        dump_memory_incident(  # savlint: disable=SAV113 -- OOM incident path: the run is already dead, forensics cannot cost it anything
+                            obs_dir,
+                            step=start_step + ledger.steps,
+                            error=repr(exc),
+                            state=state,
+                            watermark=watermark,
+                            cost=cost,
+                            manifest=manifest,
+                        )
             if feeder is not None:
                 # Publish the worker-side counters as ledger gauges (they
                 # are overlapped background time + queue depths, not
@@ -1433,9 +1542,11 @@ class Trainer:
             if watchdog is not None:
                 watchdog.stop()
             if autoprof is not None:
-                # A crash inside a capture window still leaves a
-                # finished, manifest-stamped trace behind.
-                autoprof.finalize()
+                # A crash (or normal exit) inside a capture window still
+                # leaves a finished, manifest-stamped trace behind — at
+                # the CURRENT step, so the capture's step span (and the
+                # per_step_ms the analysis divides by) stays honest.
+                autoprof.finalize(start_step + ledger.steps)
                 for k, v in autoprof.stats().items():
                     ledger.set_gauge(f"autoprof/{k}", v)
             if fleet_hb is not None:
@@ -1497,7 +1608,7 @@ class Trainer:
             if unsub_replication is not None:
                 unsub_replication()
             if profiling:
-                profiler.stop_trace()
+                profiler.stop_trace()  # savlint: disable=SAV113 -- crash inside the armed static window: close it so the trace survives
             # End-of-run roofline gauges (goodput/mfu, goodput/flops_per_s)
             # from the ledger's own aggregates — no device sync involved.
             # In the finally so crashed runs report too, and the manifest
@@ -1509,8 +1620,19 @@ class Trainer:
                 steps=ledger.steps,
                 step_seconds=ledger.bucket_seconds("step"),
             )
+            # HBM watermark: one final sample (+ the live-arrays backfill
+            # on backends without memory stats) stamped as a first-class
+            # manifest field on every exit path — the sentinel and OOM
+            # post-mortems read it without the goodput file.
+            wm = watermark.finalize()
+            if wm["peak_bytes"]:
+                ledger.set_gauge("hbm/peak_bytes", wm["peak_bytes"])
             if manifest is not None:
-                manifest.set_metrics(ledger.flat_metrics())
+                manifest.note("hbm", wm)
+                manifest.set_metrics({
+                    **ledger.flat_metrics(),
+                    "hbm_peak_bytes": wm["peak_bytes"],
+                })
                 # Attention-dispatch provenance: which backend + block
                 # config every traced attention shape resolved to (filled
                 # at trace time, so it exists once the step compiled —

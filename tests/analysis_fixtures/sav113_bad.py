@@ -1,0 +1,27 @@
+"""Known-bad fixture for SAV113: jax.profiler / memory-forensics calls
+inside the training hot path — an ad-hoc per-step trace window in fit(),
+a live-buffer walk in evaluate(), and a memdump inside the jitted step's
+dispatch wrapper."""
+import jax
+
+from sav_tpu.obs.memdump import dump_memory_incident, live_buffer_ranking
+
+
+class Trainer:
+    def fit(self, batches):
+        for step, batch in enumerate(batches):
+            jax.profiler.start_trace("/tmp/every_step")
+            state, metrics = self.step(batch)
+            jax.profiler.stop_trace()
+            if step % 10 == 0:
+                jax.profiler.save_device_memory_profile("/tmp/mem.pprof")
+
+    def evaluate(self, batches):
+        for batch in batches:
+            self.sums.append(self.eval(batch))
+            ranking = live_buffer_ranking(self.state)
+            self.rankings.append(ranking)
+
+    def train_step_placed(self, state, placed, rng):
+        dump_memory_incident(self.log_dir, state=state)
+        return self._train_step(state, placed, rng)

@@ -1,0 +1,34 @@
+"""Known-clean fixture for SAV113: the nearest legitimate idioms —
+profiling through the armed windows' own machinery (autoprof drives
+start/stop from its bounded state machine, outside the hot functions)
+and forensics on the incident path of a non-hot helper."""
+import jax
+
+from sav_tpu.obs.memdump import dump_memory_incident
+
+
+class AutoProfiler:
+    def on_step(self, step):
+        # The capture state machine is NOT a hot function: the bounded
+        # window is the sanctioned home of start/stop.
+        if self.armed is not None:
+            jax.profiler.start_trace(self.path)
+            self.active = {"stop_step": step + self.trace_steps}
+            self.armed = None
+        elif self.active and step >= self.active["stop_step"]:
+            jax.profiler.stop_trace()
+            self.active = None
+
+
+def handle_oom(log_dir, state, exc):
+    # Incident-path forensics in a dedicated handler — the run is
+    # already dead; this is not the hot loop.
+    return dump_memory_incident(log_dir, state=state, error=repr(exc))
+
+
+class Trainer:
+    def fit(self, batches):
+        for step, batch in enumerate(batches):
+            if self.autoprof is not None:
+                self.autoprof.on_step(step)
+            state, metrics = self.step(batch)

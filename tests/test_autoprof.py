@@ -228,3 +228,89 @@ def test_induced_stall_anomaly_arms_one_bounded_capture(
     gauges = trainer.last_goodput["gauges"]
     assert gauges["autoprof/captures"] == 1.0
     assert gauges["autoprof/errors"] == 0.0
+    # Post-capture trace intelligence (ISSUE 8): the capture was
+    # machine-read on the spot — the summary rides the manifest record
+    # AND the per-process sidecar, with the measured attribution keyed
+    # exactly like the cost model's predicted one.
+    from sav_tpu.obs.costs import COMP_ATTN_QKAV
+
+    sidecar = os.path.join(str(tmp_path), "autoprof",
+                           "proc0_captures.jsonl")
+    records = [json.loads(ln) for ln in open(sidecar)]
+    for record in (cap, records[-1]):
+        summary = record["summary"]
+        assert summary["per_step_ms"] > 0
+        assert summary["device_selector"] == "cpu-hlo-op"
+        assert summary["indexed_frac"] > 0.5  # the HLO op index resolved
+        measured = summary["components_frac"]
+        doc2 = RunManifest.load(manifest.path)
+        predicted = doc2["notes"]["cost_model"]["attribution"]
+        assert set(predicted).issubset(set(measured))
+        assert summary["attention_core_frac"] == pytest.approx(
+            measured[COMP_ATTN_QKAV], abs=1e-3
+        )
+        assert "disagrees" in summary
+    # The capture dir carries the offline tools' inputs: the op index
+    # and the full summary (tools/trace_report.py reads both).
+    assert os.path.exists(os.path.join(cap["path"], "op_index.json"))
+    with open(os.path.join(cap["path"], "trace_summary.json")) as f:
+        full = json.load(f)
+    assert full["vs_predicted"]["rows"]
+    assert full["steps"] == 2  # the bounded window's own step count
+    # ISSUE 8 acceptance: the capture round-trips through the offline
+    # CLI (auto-discovering trace, op index, and the manifest's
+    # predicted attribution) into a per-layer-group measured table
+    # whose groups are the same keys obs/costs.py predicts.
+    import importlib.util
+    import sys as _sys
+
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    spec = importlib.util.spec_from_file_location(
+        "trace_report", os.path.join(root, "tools", "trace_report.py")
+    )
+    trace_report = importlib.util.module_from_spec(spec)
+    _sys.modules[spec.name] = trace_report
+    spec.loader.exec_module(trace_report)
+    import contextlib
+    import io
+
+    buf = io.StringIO()
+    with contextlib.redirect_stdout(buf):
+        rc = trace_report.main([str(tmp_path), "--json"])
+    assert rc == 0
+    cli = json.loads(buf.getvalue())
+    predicted_groups = set(doc2["notes"]["cost_model"]["groups"])
+    measured_groups = set(cli["groups_frac"])
+    # Every measured group is a predicted group (or the honest 'other'
+    # bucket for top-level loss/optimizer primitives).
+    assert measured_groups - {"other"} <= predicted_groups
+    assert measured_groups & predicted_groups, cli["groups_frac"]
+    assert cli["vs_predicted"]["rows"]
+
+
+def test_analysis_failure_is_contained(tmp_path):
+    """A broken op_index_fn (or unparseable trace) counts as an error
+    gauge; the capture record still lands without its summary."""
+
+    def boom():
+        raise RuntimeError("no HLO for you")
+
+    spy = SpyProfiler()
+    prof = AutoProfiler(
+        str(tmp_path), start_fn=spy.start, stop_fn=spy.stop,
+        trace_steps=1, op_index_fn=boom,
+    )
+    # Plant a trace file so analysis actually runs into the bad index fn.
+    assert prof.request("manual", 1)
+    prof.on_step(1)
+    os.makedirs(os.path.join(prof._active["path"]), exist_ok=True)
+    import gzip
+
+    with gzip.open(
+        os.path.join(prof._active["path"], "x.trace.json.gz"), "wt"
+    ) as f:
+        f.write('{"traceEvents": []}')
+    prof.on_step(2)
+    assert len(prof.captures) == 1
+    assert "summary" not in prof.captures[0]
+    assert prof.stats()["errors"] == 1.0

@@ -98,6 +98,13 @@ BAD_EXPECTATIONS = {
         ("SAV112", 21),  # metrics[...].item() in autoprof note_window()
         ("SAV112", 24),  # float(metrics) on a bare name in request()
     ],
+    "sav113_bad.py": [
+        ("SAV113", 13),  # ad-hoc jax.profiler.start_trace in fit()
+        ("SAV113", 15),  # jax.profiler.stop_trace in fit()
+        ("SAV113", 17),  # per-N-steps device-memory pprof in fit()
+        ("SAV113", 22),  # live_buffer_ranking in evaluate()
+        ("SAV113", 26),  # memdump inside train_step_placed()
+    ],
 }
 
 CLEAN_FIXTURES = [
@@ -113,6 +120,7 @@ CLEAN_FIXTURES = [
     "sav110_clean.py",
     "sav111_clean.py",
     "sav112_clean.py",
+    "sav113_clean.py",
 ]
 
 

@@ -61,15 +61,19 @@ def test_trainer_hot_loop_suppressions_are_the_known_set():
     # 9 intentional SAV101 syncs (profiler edges, run-ahead caps, log
     # sync, boundary reads, and the flight recorder's periodic pre-step
     # snapshot — the ONE sync recording adds, at its configured cadence)
-    # + the serial-fallback SAV106. The recorder's per-step path itself
-    # must stay sync-free: that is SAV111's beat, with zero suppressions
-    # — and the fleet heartbeat/autoprof path likewise (SAV112, zero
-    # suppressions: heartbeating adds NO device syncs).
+    # + the serial-fallback SAV106 + 4 SAV113 profiling sites (the armed
+    # static window's open/close edges, its crash-path close, and the
+    # OOM memdump in fit's finally — the sanctioned windows/incident
+    # path the rule's docstring names). The recorder's per-step path
+    # itself must stay sync-free: that is SAV111's beat, with zero
+    # suppressions — and the fleet heartbeat/autoprof path likewise
+    # (SAV112, zero suppressions: heartbeating adds NO device syncs).
     assert rules.count("SAV101") == 9
     assert rules.count("SAV106") == 1
     assert rules.count("SAV111") == 0
     assert rules.count("SAV112") == 0
-    assert len(suppressed) == 10
+    assert rules.count("SAV113") == 4
+    assert len(suppressed) == 14
 
 
 # ------------------------------------------------- the gate actually bites

@@ -180,6 +180,50 @@ def test_judge_metric_directionality():
     assert wait.regressed
 
 
+def test_attention_core_frac_gates_on_where_time_went():
+    """ISSUE 8: traced benches carry the measured attention-core time
+    share (bench --trace via obs/traceview.py); a rise flags even when
+    throughput noise hides it, and untraced histories are simply not
+    scored for it."""
+    def rec(frac):
+        return normalize_run_record({
+            "value": 1000.0, "unit": "img/s/chip",
+            "attention_core_frac": frac,
+        })
+
+    stable = [rec(0.30), rec(0.31), rec(0.29)]
+    rise = sentinel.judge_metric(
+        stable + [rec(0.55)], "attention_core_frac", k=3.5,
+        rel_floor=0.05, min_history=2,
+    )
+    assert rise is not None and rise.regressed
+    drop = sentinel.judge_metric(
+        stable + [rec(0.20)], "attention_core_frac", k=3.5,
+        rel_floor=0.05, min_history=2,
+    )
+    assert drop is not None and not drop.regressed
+    # Records without the metric (untraced benches) never enter the
+    # series — a mixed history with too few traced runs is unscorable,
+    # not wrong.
+    untraced = [
+        normalize_run_record({"value": 1000.0, "unit": "img/s/chip"})
+        for _ in range(4)
+    ]
+    assert sentinel.judge_metric(
+        untraced + [rec(0.9)], "attention_core_frac", k=3.5,
+        rel_floor=0.05, min_history=2,
+    ) is None
+    # And when the NEWEST measurement is untraced, the metric is not
+    # scorable either: re-judging an older traced record as 'the
+    # candidate' would re-flag a stale value on every later untraced
+    # bench (the r8 battery runs traced benches before the headline).
+    assert sentinel.judge_metric(
+        stable + [rec(0.55)] + untraced[:1], "attention_core_frac",
+        k=3.5, rel_floor=0.05, min_history=2,
+    ) is None
+    assert "attention_core_frac" in sentinel.METRICS
+
+
 def test_insufficient_history_is_not_scored():
     records = [
         normalize_run_record({"value": 100.0, "unit": "img/s/chip"}),

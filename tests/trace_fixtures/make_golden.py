@@ -1,0 +1,137 @@
+#!/usr/bin/env python
+"""Regenerate the golden trace fixtures (checked-in .trace.json.gz).
+
+Two hand-pinned chrome-trace files exercising both device-plane
+selectors of ``sav_tpu/obs/traceview.py``:
+
+  golden_tpu.trace.json.gz — a TPU device process plane (op events named
+    by HLO instruction, no args) plus a host plane whose nested
+    ``PjitFunction`` markers pin the top-level step segmentation.
+  golden_cpu.trace.json.gz — the same ops as a CPU-backend trace: no
+    device process, ops tagged with ``hlo_op``/``hlo_module`` args on
+    XLA execution threads (what autoprof's tier-1 e2e captures).
+
+``golden_op_index.json`` maps the ops to HLO metadata scopes; the
+expected per-component/per-group totals are pinned in
+``tests/test_traceview.py`` — change either side consciously.
+
+Deterministic output (gzip mtime pinned to 0) so regeneration diffs are
+meaningful: ``python tests/trace_fixtures/make_golden.py``.
+"""
+
+import gzip
+import json
+import os
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+
+# (op name, duration us, metadata scope or None)
+OPS = [
+    ("dot.1", 2000.0,
+     "jit(step)/jit(main)/jvp(Model)/Encoder_0/block_0/"
+     "SelfAttentionBlock_0/to_qkv/dot_general"),
+    ("fusion.2", 3000.0,
+     "jit(step)/jit(main)/jvp(Model)/Encoder_0/block_0/"
+     "SelfAttentionBlock_0/SelfAttentionBlock_0/softmax"),
+    ("dot.3", 1000.0,
+     "jit(step)/jit(main)/transpose(jvp(Model))/Encoder_0/block_0/"
+     "FFBlock_0/fc1/dot_general"),
+    ("convolution.4", 2000.0,
+     "jit(step)/jit(main)/jvp(Model)/PatchEmbedBlock_0/proj/"
+     "conv_general_dilated"),
+    ("dot.5", 500.0, "jit(step)/jit(main)/jvp(Model)/head/dot_general"),
+    ("fusion.6", 1500.0, "jit(step)/jit(main)/add"),
+    ("copy.7", 1000.0, None),  # deliberately NOT in the op index
+]
+
+
+def _host_plane(pid):
+    """Host plane: 2 top-level step markers, each emitted twice (the
+    profiler's re-entrant TraceMe) — pins the top-level dedupe."""
+    events = [
+        {"ph": "M", "pid": pid, "name": "process_name",
+         "args": {"name": "python"}},
+    ]
+    for ts in (0.0, 20000.0):
+        for _ in range(2):  # nested duplicate, same span
+            events.append({
+                "ph": "X", "pid": pid, "tid": 1, "ts": ts, "dur": 11000.0,
+                "name": "PjitFunction(_train_step_impl)",
+            })
+    return events
+
+
+def make_tpu():
+    pid_dev, pid_host = 7, 99
+    events = [
+        {"ph": "M", "pid": pid_dev, "name": "process_name",
+         "args": {"name": "/device:TPU:0 (pid 7)"}},
+        # The xprof export's per-device thread layout: the per-op rows
+        # plus AGGREGATE rows ("XLA Modules", "Steps") whose events
+        # span whole steps ON THE SAME PID — the parser must count the
+        # op rows only, or every op is double/triple-booked and
+        # idle_frac pins at 0.
+        {"ph": "M", "pid": pid_dev, "tid": 2, "name": "thread_name",
+         "args": {"name": "XLA Ops"}},
+        {"ph": "M", "pid": pid_dev, "tid": 5, "name": "thread_name",
+         "args": {"name": "XLA Modules"}},
+        {"ph": "M", "pid": pid_dev, "tid": 6, "name": "thread_name",
+         "args": {"name": "Steps"}},
+    ]
+    ts = 0.0
+    for name, dur, _ in OPS:
+        events.append({
+            "ph": "X", "pid": pid_dev, "tid": 2, "ts": ts, "dur": dur,
+            "name": name,
+        })
+        ts += dur
+    # Aggregate rows spanning the whole window — excluded from totals.
+    events.append({
+        "ph": "X", "pid": pid_dev, "tid": 5, "ts": 0.0, "dur": ts,
+        "name": "jit_step",
+    })
+    events.append({
+        "ph": "X", "pid": pid_dev, "tid": 6, "ts": 0.0, "dur": ts,
+        "name": "1",
+    })
+    events += _host_plane(pid_host)
+    return {"traceEvents": events}
+
+
+def make_cpu():
+    pid = 701
+    events = [
+        {"ph": "M", "pid": pid, "name": "process_name",
+         "args": {"name": "/host:CPU"}},
+    ]
+    ts = 0.0
+    for name, dur, _ in OPS:
+        events.append({
+            "ph": "X", "pid": pid, "tid": 3, "ts": ts, "dur": dur,
+            "name": name,
+            "args": {"hlo_module": "jit_step", "hlo_op": name},
+        })
+        ts += dur
+    events += _host_plane(pid)
+    return {"traceEvents": events}
+
+
+def write(name, doc):
+    path = os.path.join(HERE, name)
+    with open(path, "wb") as raw:
+        with gzip.GzipFile(fileobj=raw, mode="wb", mtime=0) as f:
+            f.write(json.dumps(doc, sort_keys=True).encode())
+    print(f"wrote {path}")
+
+
+def main():
+    write("golden_tpu.trace.json.gz", make_tpu())
+    write("golden_cpu.trace.json.gz", make_cpu())
+    index = {name: scope for name, _, scope in OPS if scope is not None}
+    with open(os.path.join(HERE, "golden_op_index.json"), "w") as f:
+        json.dump(index, f, indent=2, sort_keys=True)
+    print("wrote golden_op_index.json")
+
+
+if __name__ == "__main__":
+    main()

@@ -217,6 +217,26 @@ def render(log_dir: str, summary: dict, out) -> None:
                 f"{c.get('path')}",
                 file=out,
             )
+            s = c.get("summary") or {}
+            if s:
+                # Post-capture trace intelligence (obs/traceview.py):
+                # the capture is already machine-read — render the
+                # attribution headline instead of just the blob path.
+                acf = s.get("attention_core_frac")
+                disagrees = s.get("disagrees") or []
+                print(
+                    f"    {s.get('per_step_ms')} ms/step device time, "
+                    f"indexed {s.get('indexed_frac', 0.0):.0%}"
+                    + (
+                        f", attention core {acf:.1%}"
+                        if acf is not None else ""
+                    )
+                    + (
+                        "; DISAGREES with cost model: "
+                        + ", ".join(disagrees) if disagrees else ""
+                    ),
+                    file=out,
+                )
 
 
 def main(argv=None) -> int:

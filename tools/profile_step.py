@@ -1,64 +1,52 @@
 #!/usr/bin/env python
-"""Trace the DeiT-S train step and print device-op time by bucket.
+"""Trace the train step and print device-op time by kind and layer group.
 
-Runs N steady-state steps under jax.profiler.trace, parses the resulting
-xplane proto (TensorFlow's profiler schema), and aggregates device-plane op
-durations by HLO op name / fusion, so optimization targets are measured,
-not guessed.
+Runs N steady-state steps under ``jax.profiler.trace``, then machine-
+reads the capture through the repo's one trace parser
+(``sav_tpu/obs/traceview.py`` — the same analysis autoprof runs on its
+own captures): per-op device time, op-kind buckets, and — because this
+harness holds the compiled executable — exact per-layer-group
+attribution via the HLO metadata op index, which it also writes next to
+the trace (``op_index.json``) so ``tools/trace_report.py`` can re-read
+the capture offline.
+
+The step runs through the public ``Trainer.compile_train_step`` AOT
+surface (the sibling of ``train_step_placed`` — the same compiled
+program the cost analysis reads), not the private
+``trainer._train_step``.
 """
 
 from __future__ import annotations
 
 import argparse
-import glob
-import gzip
 import os
-from collections import defaultdict
+import sys
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO_ROOT not in sys.path:  # runnable as a script from anywhere
+    sys.path.insert(0, _REPO_ROOT)
+
+from sav_tpu.obs import traceview  # noqa: E402  (stdlib-only)
 
 
 def device_op_times(trace_json_gz):
-    """Sum complete-event durations per op name on the TPU device track."""
-    with gzip.open(trace_json_gz) as f:
-        tr = __import__("json").load(f)
-    events = tr["traceEvents"]
-    device_pids = {
-        e["pid"]
-        for e in events
-        if e.get("ph") == "M"
-        and e.get("name") == "process_name"
-        and "TPU" in e["args"].get("name", "")
-    }
-    totals = defaultdict(float)
-    counts = defaultdict(int)
-    for e in events:
-        if e.get("ph") == "X" and e.get("pid") in device_pids:
-            totals[e["name"]] += e.get("dur", 0) / 1e3  # us -> ms
-            counts[e["name"]] += 1
+    """Back-compat shim: per-op (totals ms, counts) of one trace file.
+
+    Thin wrapper over :func:`sav_tpu.obs.traceview.device_op_times` —
+    TPU device planes first, CPU ``hlo_op``-tagged events as fallback,
+    so CPU-backend captures parse to real totals too.
+    """
+    events = traceview.load_trace(trace_json_gz)
+    totals, counts, _ = traceview.device_op_times(events)
     return totals, counts
 
 
-def bucket(name: str) -> str:
-    n = name.lower()
-    if "softmax" in n:
-        return "softmax"
-    if "transpose" in n:
-        return "transpose"
-    if "fusion" in n:
-        return "fusion(other)"
-    if "dot" in n or "conv" in n:
-        return "dot/conv"
-    if "copy" in n or "bitcast" in n:
-        return "copy/layout"
-    if "all-reduce" in n or "collective" in n:
-        return "collective"
-    return "other"
-
-
 def main():
-    p = argparse.ArgumentParser()
+    p = argparse.ArgumentParser(description=__doc__)
     p.add_argument("--steps", type=int, default=5)
     p.add_argument("--batch-size", type=int, default=256)
     p.add_argument("--model", default="deit_s_patch16")
+    p.add_argument("--image-size", type=int, default=224)
     p.add_argument("--out", default="/tmp/step_trace")
     p.add_argument("--top", type=int, default=40)
     args = p.parse_args()
@@ -66,12 +54,13 @@ def main():
     import jax
 
     from sav_tpu.data import synthetic_data_iterator
+    from sav_tpu.obs.costs import train_step_cost
     from sav_tpu.train import TrainConfig, Trainer
 
     config = TrainConfig(
         model_name=args.model,
         num_classes=1000,
-        image_size=224,
+        image_size=args.image_size,
         compute_dtype="bfloat16",
         attention_backend="xla",
         global_batch_size=args.batch_size,
@@ -81,46 +70,85 @@ def main():
     )
     trainer = Trainer(config)
     state = trainer.init_state(0)
-    batch = trainer.shard_batch(
+    placed = trainer.shard_batch(
         next(
             synthetic_data_iterator(
                 batch_size=args.batch_size,
-                image_size=224,
+                image_size=args.image_size,
                 num_classes=1000,
                 learnable=False,
             )
         )
     )
     rng = jax.random.PRNGKey(0)
-    step = trainer._train_step
+    # One AOT compile through the public surface: the timed loop runs
+    # the same executable whose HLO metadata builds the op index
+    # (instruction names must match the trace's), and whose cost
+    # analysis provides the predicted side.
+    step = trainer.compile_train_step(state, placed, rng)
+    op_index = traceview.parse_hlo_op_index(step.as_text())
+    cost = train_step_cost(
+        state.params, batch_size=args.batch_size,
+        image_size=args.image_size, compiled=step,
+        n_devices=len(jax.devices()),
+    )
     for _ in range(3):
-        state, metrics = step(state, batch, rng)
+        state, metrics = step(state, placed, rng)
     jax.device_get(metrics["loss"])
 
     with jax.profiler.trace(args.out):
         for _ in range(args.steps):
-            state, metrics = step(state, batch, rng)
+            state, metrics = step(state, placed, rng)
         jax.device_get(metrics["loss"])
 
-    traces = sorted(
-        glob.glob(os.path.join(args.out, "**", "*.trace.json.gz"), recursive=True),
-        key=os.path.getmtime,
-    )
+    traces = traceview.find_traces(args.out)
     if not traces:
         raise SystemExit(f"no trace.json.gz under {args.out}")
-    totals, counts = device_op_times(traces[-1])
+    trace = traces[-1]
+    traceview.save_op_index(
+        os.path.join(os.path.dirname(trace), "op_index.json"), op_index
+    )
+    summary = traceview.summarize(
+        trace,
+        op_index=op_index,
+        predicted=cost.attribution,
+        steps=args.steps,
+        top_ops=args.top,
+    )
 
-    per_step = {k: v / args.steps for k, v in totals.items()}
-    total = sum(per_step.values())
-    print(f"device op time: {total:.1f} ms/step over {args.steps} steps")
-    buckets = defaultdict(float)
-    for k, v in per_step.items():
-        buckets[bucket(k)] += v
-    for k, v in sorted(buckets.items(), key=lambda kv: -kv[1]):
-        print(f"  {k:15s} {v:8.2f} ms/step")
+    per_step = summary.get("per_step_ms") or 0.0
+    print(
+        f"device op time: {per_step:.1f} ms/step over {args.steps} steps "
+        f"(plane: {summary.get('device_selector')}, "
+        f"indexed {summary.get('indexed_frac', 0.0):.0%})"
+    )
+    total = sum(summary.get("kinds_ms", {}).values()) or 1.0
+    for kind, ms in summary.get("kinds_ms", {}).items():
+        print(f"  {kind:15s} {ms / args.steps:8.2f} ms/step "
+              f"{ms / total:6.1%}")
+    vs = summary.get("vs_predicted")
+    if vs:
+        print("\nmeasured (time) vs predicted (FLOPs) attribution:")
+        for row in vs.get("rows", []):
+            flag = "  <-- DISAGREES" if row.get("flagged") else ""
+            print(
+                f"  {row['component']:<16} measured "
+                f"{row['measured_frac']:>7.1%}  predicted "
+                f"{row['predicted_frac']:>7.1%}{flag}"
+            )
+    groups = summary.get("groups_frac", {})
+    if groups:
+        print("\nper layer group:")
+        for group, frac in sorted(groups.items(), key=lambda kv: -kv[1]):
+            print(f"  {group:<24} {frac:>7.1%}")
     print(f"\ntop {args.top} ops:")
-    for k, v in sorted(per_step.items(), key=lambda kv: -kv[1])[: args.top]:
-        print(f"  {v:8.3f} ms  x{counts[k]//args.steps:<4d} {k[:110]}")
+    for row in summary.get("top_ops", []):
+        scope = row.get("scope")
+        print(
+            f"  {row['ms'] / args.steps:8.3f} ms  "
+            f"x{row['count'] // max(args.steps, 1):<4d} {row['op'][:80]}"
+            + (f"  [{scope[-70:]}]" if scope else "")
+        )
 
 
 if __name__ == "__main__":

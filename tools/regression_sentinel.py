@@ -19,7 +19,10 @@ zero-variance history from flagging sub-percent jitter.
 
 Tracked metrics: ``throughput`` (img/s/chip, higher is better), ``mfu``
 (higher), ``input_wait_frac`` (share of wall time blocked on input,
-lower). Infra failures are *reported but never scored* — a down relay is
+lower), ``attention_core_frac`` (measured attention-core share of
+device time from ``bench.py --trace``, lower — present only on traced
+benches; untraced records are skipped, not zero-filled). Infra failures
+are *reported but never scored* — a down relay is
 not a regression (the BENCH_r05 lesson), and a history whose only deltas
 are infra failures exits clean.
 
@@ -64,6 +67,12 @@ METRICS = {
     "throughput": (True, 0.0),
     "mfu": (True, 0.0),
     "input_wait_frac": (False, 0.01),
+    # Measured attention-core share of device time (bench --trace via
+    # obs/traceview.py): lower is better — a rise means the step got
+    # slower WHERE the fused-kernel work lives, even if throughput noise
+    # hides it. Absolute floor: two points of step share, same rationale
+    # as input_wait_frac's (a flat history must not flag jitter).
+    "attention_core_frac": (False, 0.02),
 }
 
 EXIT_CLEAN, EXIT_REGRESSION, EXIT_USAGE = 0, 1, 2
@@ -97,11 +106,17 @@ def judge_metric(
 ):
     """Verdict for one metric over ordered records (None = not scorable)."""
     higher_better, abs_floor = METRICS[metric]
+    ok_records = [r for r in records if r.ok]
     series = [
-        (r, r.metrics[metric]) for r in records
-        if r.ok and metric in r.metrics
+        (r, r.metrics[metric]) for r in ok_records if metric in r.metrics
     ]
     if len(series) < min_history + 1:
+        return None
+    if series[-1][0] is not ok_records[-1]:
+        # The newest measurement does not carry this metric (e.g. an
+        # untraced bench after traced ones — attention_core_frac is
+        # optional): scoring would re-judge a STALE record as "the
+        # candidate" and re-flag an old value forever. Not scorable.
         return None
     (candidate_rec, candidate) = series[-1]
     baseline = [v for _, v in series[:-1]]

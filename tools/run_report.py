@@ -279,6 +279,135 @@ def report_manifest(doc: dict, out) -> None:
             "see the Incidents section / tools/replay_step.py",
             file=out,
         )
+    hbm = notes.get("hbm") or {}
+    peak = hbm.get("peak_bytes") or doc.get("metrics", {}).get(
+        "hbm_peak_bytes"
+    )
+    if peak:
+        print(
+            f"  HBM watermark: {_fmt_bytes(float(peak))} peak "
+            f"({hbm.get('source', '?')})",
+            file=out,
+        )
+    if notes.get("memdump"):
+        md = notes["memdump"]
+        print(
+            f"  MEMDUMP: memory-forensics bundle at step {md.get('step')} "
+            f"({md.get('path')}) — see the Incidents section",
+            file=out,
+        )
+
+
+def _render_memdump(name: str, bundle: str, out) -> None:
+    """One memory-forensics bundle (sav_tpu/obs/memdump.py): live-buffer
+    classes, the top resident buffers, and the watermark — the OOM
+    post-mortem without spelunking a pprof."""
+    try:
+        with open(os.path.join(bundle, "memdump.json")) as f:
+            doc = json.load(f)
+    except (OSError, json.JSONDecodeError):
+        print(f"  {name}: (unreadable/torn memdump.json)", file=out)
+        return
+    live = doc.get("live") or {}
+    wm = doc.get("watermark") or {}
+    print(
+        f"  {name}: {doc.get('trigger')} at step {doc.get('step')} — "
+        f"{_fmt_bytes(live.get('total_bytes', 0.0))} live in "
+        f"{live.get('num_buffers', 0)} buffers"
+        + (
+            f", watermark {_fmt_bytes(wm['peak_bytes'])} "
+            f"({wm.get('source')})" if wm.get("peak_bytes") else ""
+        )
+        + (", pprof saved" if doc.get("pprof") else ""),
+        file=out,
+    )
+    if doc.get("error"):
+        print(f"    error: {str(doc['error'])[:120]}", file=out)
+    classes = live.get("class_bytes") or {}
+    if classes:
+        print(
+            "    by class: " + ", ".join(
+                f"{cls} {_fmt_bytes(b)}"
+                for cls, b in sorted(classes.items(), key=lambda kv: -kv[1])
+                if b
+            ),
+            file=out,
+        )
+    for row in (live.get("buffers") or [])[:5]:
+        group = f" [{row['group']}]" if row.get("group") else ""
+        print(
+            f"    {_fmt_bytes(row.get('bytes', 0.0)):>10} x"
+            f"{row.get('count', 0):<4d} {row.get('class')}{group} "
+            f"{row.get('dtype')}{row.get('shape')}",
+            file=out,
+        )
+
+
+def report_traces(log_dir: str, out) -> None:
+    """Render trace-intelligence summaries (docs/profiling.md): every
+    autoprof capture's ``trace_summary.json`` plus bench's traced
+    window, as measured-vs-predicted component tables."""
+    import glob as _glob
+
+    paths = sorted(
+        _glob.glob(
+            os.path.join(log_dir, "autoprof", "*", "trace_summary.json")
+        )
+    ) + sorted(
+        _glob.glob(
+            os.path.join(log_dir, "trace", "**", "trace_summary.json"),
+            recursive=True,
+        )
+    )
+    if not paths:
+        print(
+            f"(no trace summaries under {log_dir} — capture with "
+            "--autoprof / bench --trace, or run tools/trace_report.py "
+            "on a raw trace)",
+            file=out,
+        )
+        return
+    print(f"Trace summaries: {len(paths)}", file=out)
+    for path in paths:
+        try:
+            with open(path) as f:
+                s = json.load(f)
+        except (OSError, json.JSONDecodeError):
+            print(f"  {path}: (unreadable/torn)", file=out)
+            continue
+        rel = os.path.relpath(path, log_dir)
+        idle = s.get("idle_frac")
+        acf = s.get("attention_core_frac")
+        print(
+            f"  {os.path.dirname(rel)}: {s.get('per_step_ms')} ms/step "
+            f"device time ({s.get('device_selector')}, indexed "
+            f"{s.get('indexed_frac', 0.0):.0%}"
+            + (f", idle {idle:.0%}" if idle is not None else "")
+            + (f", attention core {acf:.1%}" if acf is not None else "")
+            + ")",
+            file=out,
+        )
+        vs = s.get("vs_predicted")
+        if vs:
+            for row in vs.get("rows", []):
+                flag = "  <-- DISAGREES" if row.get("flagged") else ""
+                print(
+                    f"    {row['component']:<16} measured "
+                    f"{row['measured_frac']:>7.1%}  predicted "
+                    f"{row['predicted_frac']:>7.1%}{flag}",
+                    file=out,
+                )
+        else:
+            comps = ", ".join(
+                f"{k} {v:.0%}"
+                for k, v in sorted(
+                    (s.get("components_frac") or {}).items(),
+                    key=lambda kv: -kv[1],
+                )
+                if v
+            )
+            if comps:
+                print(f"    {comps}", file=out)
 
 
 def report_incidents(log_dir: str, out) -> None:
@@ -294,6 +423,9 @@ def report_incidents(log_dir: str, out) -> None:
     print(f"Incidents: {len(bundles)} bundle(s) under {root}", file=out)
     for name in bundles:
         bundle = os.path.join(root, name)
+        if name.startswith("memdump_"):
+            _render_memdump(name, bundle, out)
+            continue
         try:
             with open(os.path.join(bundle, "incident.json")) as f:
                 doc = json.load(f)
@@ -474,6 +606,14 @@ def main(argv=None) -> int:
         "directory exists. Degrades gracefully on runs without one.",
     )
     parser.add_argument(
+        "--trace", action="store_true",
+        help="render the log dir's trace-intelligence summaries "
+        "(autoprof captures' trace_summary.json, bench --trace windows) "
+        "as measured-vs-predicted attribution tables "
+        "(docs/profiling.md); also rendered automatically when an "
+        "autoprof/ directory exists",
+    )
+    parser.add_argument(
         "--incidents", action="store_true",
         help="render the log dir's flight-recorder incident bundles "
         "(<log-dir>/incidents/) with their replay verdicts; incident "
@@ -496,6 +636,10 @@ def main(argv=None) -> int:
         if args.bench is None:
             parser.error("--fleet needs a log dir to look under")
         print("(--fleet ignored: no log dir given)", file=sys.stderr)
+    if args.trace and args.log_dir is None:
+        if args.bench is None:
+            parser.error("--trace needs a log dir to look under")
+        print("(--trace ignored: no log dir given)", file=sys.stderr)
 
     if args.bench:
         rc = report_bench_history(args.bench, sys.stdout)
@@ -540,6 +684,11 @@ def main(argv=None) -> int:
         or os.path.isdir(os.path.join(args.log_dir, "incidents"))
     ):
         report_incidents(args.log_dir, out)
+
+    if args.log_dir and (
+        args.trace or os.path.isdir(os.path.join(args.log_dir, "autoprof"))
+    ):
+        report_traces(args.log_dir, out)
 
     if args.log_dir and (
         args.fleet or os.path.isdir(fleet_dir(args.log_dir))

@@ -1,0 +1,264 @@
+#!/usr/bin/env python
+"""Machine-read a jax.profiler trace: per-op time attributed onto the
+cost model's layer groups, measured vs predicted side by side.
+
+The offline CLI over ``sav_tpu/obs/traceview.py`` — the same analysis
+``AutoProfiler`` runs on its own captures, pointed at any trace:
+
+  python tools/trace_report.py runs/fleet_r8                 # log dir
+  python tools/trace_report.py runs/x/autoprof/proc0_step...  # capture
+  python tools/trace_report.py /tmp/step_trace --json        # profile dir
+  python tools/trace_report.py trace.json.gz --op-index op_index.json
+
+Auto-discovery: the newest ``*.trace.json.gz`` under the given path; an
+``op_index.json`` next to the trace / in any parent (AutoProfiler and
+``tools/profile_step.py`` write one — without it, attribution degrades
+to op-kind buckets and says so); the nearest ``manifest.json`` walking
+up from the trace for the cost model's predicted attribution
+(``notes.cost_model.attribution``) — ``--manifest`` overrides.
+
+Output: capture header (steps, per-step device ms, idle share), the
+measured-vs-predicted component table with per-row deltas and
+disagreement flags (beyond ``--tolerance``), the per-layer-group table,
+op-kind buckets, and the top ops. ``--json`` emits the full
+machine-readable summary (the battery feeds it to the bench line /
+sentinel).
+
+Stdlib-only (no jax import): safe on a laptop against rsynced logs.
+
+Exit codes: 0 rendered; 2 usage/IO (no trace found, unreadable input).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from typing import Optional
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO_ROOT not in sys.path:  # runnable as a script from anywhere
+    sys.path.insert(0, _REPO_ROOT)
+
+# Stdlib-only module (no jax) — the laptop-safety contract holds.
+from sav_tpu.obs import traceview  # noqa: E402
+
+
+def find_manifest_predicted(start: str) -> tuple[Optional[dict], str]:
+    """Nearest manifest.json (walking up from ``start``) carrying a cost
+    model note; returns (attribution | None, manifest path | '')."""
+    probe = start if os.path.isdir(start) else os.path.dirname(start)
+    for _ in range(6):
+        candidate = os.path.join(probe, "manifest.json")
+        if os.path.exists(candidate):
+            try:
+                with open(candidate) as f:
+                    doc = json.load(f)
+            except (OSError, json.JSONDecodeError):
+                return None, ""
+            attribution = (
+                (doc.get("notes") or {}).get("cost_model") or {}
+            ).get("attribution")
+            if isinstance(attribution, dict):
+                return attribution, candidate
+            return None, candidate
+        parent = os.path.dirname(probe)
+        if parent == probe:
+            break
+        probe = parent
+    return None, ""
+
+
+def _bar(frac: float, width: int = 30) -> str:
+    return "#" * int(round(width * max(0.0, min(frac, 1.0))))
+
+
+def render(summary: dict, out) -> None:
+    print(f"== Trace report: {summary.get('trace')} ==", file=out)
+    steps = summary.get("steps")
+    per_step = summary.get("per_step_ms")
+    print(
+        f"device plane: {summary.get('device_selector')} "
+        f"({summary.get('num_ops')} distinct ops, "
+        f"{summary.get('total_ms')} ms device time"
+        + (f" over {steps} steps = {per_step} ms/step" if steps else "")
+        + ")",
+        file=out,
+    )
+    idle = summary.get("idle_frac")
+    if idle is not None:
+        print(
+            f"capture span {summary.get('span_ms')} ms, device busy "
+            f"{summary.get('busy_ms')} ms — idle/gap share {idle:.1%}",
+            file=out,
+        )
+    indexed = summary.get("indexed_frac", 0.0)
+    if indexed:
+        fwd, bwd = summary.get("fwd_ms", 0.0), summary.get("bwd_ms", 0.0)
+        print(
+            f"scope-indexed: {indexed:.1%} of device time "
+            f"(fwd+update {fwd} ms / bwd {bwd} ms)",
+            file=out,
+        )
+        vs = summary.get("vs_predicted")
+        if vs is not None:
+            print(
+                "measured (time) vs predicted (FLOPs) attribution "
+                f"[tolerance {vs.get('tolerance')}]:",
+                file=out,
+            )
+            for row in vs.get("rows", []):
+                flag = "  <-- DISAGREES" if row.get("flagged") else ""
+                print(
+                    f"  {row['component']:<16} measured "
+                    f"{row['measured_frac']:>7.1%}  predicted "
+                    f"{row['predicted_frac']:>7.1%}  delta "
+                    f"{row['delta']:>+7.1%}{flag}",
+                    file=out,
+                )
+        else:
+            print("measured attribution (no cost model found):", file=out)
+            for comp, frac in sorted(
+                summary.get("components_frac", {}).items(),
+                key=lambda kv: -kv[1],
+            ):
+                print(f"  {comp:<16} {frac:>7.1%}  {_bar(frac)}", file=out)
+        acf = summary.get("attention_core_frac")
+        if acf is not None:
+            print(f"attention core (QK/AV+softmax): {acf:.1%} of device "
+                  "time", file=out)
+        groups = summary.get("groups_frac", {})
+        if groups:
+            print("per layer group:", file=out)
+            for group, frac in sorted(groups.items(), key=lambda kv: -kv[1]):
+                print(
+                    f"  {group:<24} {frac:>7.1%}  {_bar(frac)}", file=out
+                )
+    else:
+        print(
+            "(no scope index found — attribution degrades to op-kind "
+            "buckets; pass --op-index or re-capture via autoprof/"
+            "profile_step, which write op_index.json)",
+            file=out,
+        )
+    kinds = summary.get("kinds_ms", {})
+    if kinds:
+        total = sum(kinds.values()) or 1.0
+        print("op kinds:", file=out)
+        for kind, ms in kinds.items():
+            print(
+                f"  {kind:<14} {ms:>10.3f} ms  {ms / total:>6.1%}",
+                file=out,
+            )
+    top = summary.get("top_ops", [])
+    if top:
+        print(f"top {len(top)} ops:", file=out)
+        for row in top:
+            scope = row.get("scope")
+            print(
+                f"  {row['ms']:>9.3f} ms  x{row['count']:<5d} "
+                f"{row['op'][:60]:<60}"
+                + (f"  [{scope[-60:]}]" if scope else ""),
+                file=out,
+            )
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter,
+    )
+    parser.add_argument(
+        "path",
+        help="trace file (*.trace.json.gz), autoprof capture dir, "
+        "profile dir, or a run log dir (newest trace under it wins)",
+    )
+    parser.add_argument(
+        "--op-index", default=None,
+        help="explicit op_index.json ({hlo op -> metadata scope}); "
+        "default: auto-discovered next to the trace",
+    )
+    parser.add_argument(
+        "--manifest", default=None,
+        help="manifest.json to read the predicted cost-model attribution "
+        "from; default: the nearest one walking up from the trace",
+    )
+    parser.add_argument(
+        "--steps", type=int, default=None,
+        help="step count of the capture window (default: the trace's own "
+        "step markers)",
+    )
+    parser.add_argument(
+        "--tolerance", type=float,
+        default=traceview.DISAGREEMENT_TOLERANCE,
+        help="measured-vs-predicted attribution gap that flags a "
+        "component as disagreeing",
+    )
+    parser.add_argument("--top", type=int, default=10, help="top ops shown")
+    parser.add_argument(
+        "--json", action="store_true",
+        help="emit the full machine-readable summary",
+    )
+    args = parser.parse_args(argv)
+
+    if not os.path.exists(args.path):
+        print(f"trace_report: no such path: {args.path}", file=sys.stderr)
+        return 2
+    traces = traceview.find_traces(args.path)
+    if not traces:
+        print(
+            f"trace_report: no *.trace.json.gz under {args.path}",
+            file=sys.stderr,
+        )
+        return 2
+    trace = traces[-1]
+
+    op_index = None
+    if args.op_index:
+        try:
+            with open(args.op_index) as f:
+                op_index = json.load(f)
+        except (OSError, json.JSONDecodeError) as e:
+            print(f"trace_report: cannot read --op-index: {e}",
+                  file=sys.stderr)
+            return 2
+    else:
+        op_index = traceview.load_op_index(trace)
+
+    predicted = None
+    if args.manifest:
+        try:
+            with open(args.manifest) as f:
+                doc = json.load(f)
+            predicted = (
+                (doc.get("notes") or {}).get("cost_model") or {}
+            ).get("attribution")
+        except (OSError, json.JSONDecodeError) as e:
+            print(f"trace_report: cannot read --manifest: {e}",
+                  file=sys.stderr)
+            return 2
+    else:
+        predicted, _ = find_manifest_predicted(trace)
+
+    try:
+        summary = traceview.summarize(
+            trace,
+            op_index=op_index,
+            predicted=predicted,
+            steps=args.steps,
+            tolerance=args.tolerance,
+            top_ops=args.top,
+        )
+    except (OSError, ValueError, json.JSONDecodeError) as e:
+        print(f"trace_report: cannot parse {trace}: {e}", file=sys.stderr)
+        return 2
+    if args.json:
+        print(json.dumps(summary, indent=2))
+    else:
+        render(summary, sys.stdout)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -225,6 +225,15 @@ import click
     "(the recorder's max_incidents discipline applied to traces).",
 )
 @click.option(
+    "--memdump/--no-memdump", default=True,
+    help="Memory forensics (docs/profiling.md): on an OOM-classified "
+    "crash, dump a live-buffer ranking (classified params/opt-state/"
+    "unattributed against the cost model's per-group byte estimates), "
+    "an HBM snapshot, and a device-memory pprof under "
+    "<log-dir>/incidents/memdump_<step>/. The run's peak-HBM watermark "
+    "is stamped into the manifest regardless.",
+)
+@click.option(
     "--record/--no-record", default=False,
     help="Flight recorder (docs/incident_replay.md): keep a bounded ring "
     "of the last steps' host-side context (batch hashes + raw batches, "
@@ -357,7 +366,7 @@ def _run(
     num_eval_images, crop_min_area, train_flip, platform, backend_wait,
     fused_optimizer, log_dir, diagnostics, trace_spans, watchdog_secs,
     watchdog_soft_secs, fleet, autoprof, autoprof_steps, autoprof_max,
-    record, record_depth, record_batches, spike_sigma,
+    memdump, record, record_depth, record_batches, spike_sigma,
     sanitize, device_preprocess, async_feed, feed_depth,
     compilation_cache_dir, peak_flops, seed,
 ):
@@ -486,6 +495,7 @@ def _run(
         autoprof=autoprof,
         autoprof_steps=autoprof_steps,
         autoprof_max=autoprof_max,
+        memdump=memdump,
         record=record,
         record_depth=record_depth,
         record_batches=record_batches,
@@ -525,6 +535,7 @@ def _run(
             "fleet": "fleet", "autoprof": "autoprof",
             "autoprof_steps": "autoprof_steps",
             "autoprof_max": "autoprof_max",
+            "memdump": "memdump",
             "record": "record", "record_depth": "record_depth",
             "record_batches": "record_batches",
             "spike_sigma": "spike_sigma",

@@ -1,0 +1,66 @@
+"""Shared PEP 562 lazy re-export machinery for the package ``__init__``s.
+
+Four subpackages (:mod:`sav_tpu.utils`, :mod:`sav_tpu.obs`,
+:mod:`sav_tpu.data`, :mod:`sav_tpu.train`) carry the same import
+contract: their stdlib-only submodules (``backend_probe``, ``manifest``,
+``synthetic``, ``supervisor`` ...) must be importable without dragging
+``jax``/TF into the process — the backend probe and the elasticity
+supervisor run on exactly the paths (down relay, on-chip parent) where a
+heavy import hangs or delays the abort decision. One factory instead of
+four hand-copied ``__getattr__``/``__dir__`` bodies keeps the contract's
+implementation in one place.
+
+Stdlib-only, and importing it only executes ``sav_tpu/__init__``'s
+docstring — free on every path.
+"""
+
+from __future__ import annotations
+
+from typing import Iterable
+
+
+def install_lazy_exports(
+    namespace: dict, exports: dict, submodules: Iterable[str] = ()
+):
+    """Build a package's lazy ``(__getattr__, __dir__)`` pair.
+
+    Args:
+      namespace: the package ``__init__``'s ``globals()`` — resolved
+        names are cached into it so each import happens once.
+      exports: re-export name -> defining module (``"TrainConfig":
+        "sav_tpu.train.config"``).
+      submodules: names that resolve to the submodule itself (keeps
+        ``sav_tpu.utils.metrics``-after-``import sav_tpu.utils`` working
+        the way eager imports used to bind them).
+
+    Usage in an ``__init__.py``::
+
+        _EXPORTS = {...}
+        __all__ = list(_EXPORTS)
+        __getattr__, __dir__ = install_lazy_exports(
+            globals(), _EXPORTS, {"submodule", ...}
+        )
+    """
+    package = namespace["__name__"]
+    submodules = frozenset(submodules)
+
+    def __getattr__(name: str):
+        import importlib
+
+        if name in submodules:
+            module = importlib.import_module(f"{package}.{name}")
+            namespace[name] = module
+            return module
+        target = exports.get(name)
+        if target is None:
+            raise AttributeError(
+                f"module {package!r} has no attribute {name!r}"
+            )
+        value = getattr(importlib.import_module(target), name)
+        namespace[name] = value
+        return value
+
+    def __dir__():
+        return sorted(set(namespace) | set(exports) | submodules)
+
+    return __getattr__, __dir__

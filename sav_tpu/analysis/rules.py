@@ -1047,6 +1047,92 @@ class ProfilerInHotPath(Rule):
                     )
 
 
+# ---------------------------------------------------------------- SAV114
+
+
+class BareExitInLibrary(Rule):
+    """``sys.exit`` / ``os._exit`` / ``raise SystemExit`` in library code.
+
+    The elasticity layer (docs/elasticity.md) depends on a strict
+    exit-code contract: 0 ok, 2 usage, 3 backend-unreachable, 4 hang —
+    and on every abnormal exit flowing through the paths that finalize
+    the run manifest, drain in-flight async checkpoint saves, and dump
+    incident bundles. A bare exit buried in ``sav_tpu/`` breaks both at
+    once: ``sys.exit`` raises ``SystemExit`` from an arbitrary depth
+    (callers' except-Exception blocks don't see it; an unexpected code
+    confuses supervisors into misclassifying the restart reason), and
+    ``os._exit`` skips every finally/atexit — the crash telemetry the
+    whole obs stack exists to write. Library code raises exceptions;
+    only the CLIs (train.py, bench.py, tools/) own process exit. The two
+    sanctioned library sites — the hang watchdog's ``os._exit`` (a
+    wedged main thread cannot be unwound) and the backend probe's
+    ``SystemExit(3)`` (the documented abort contract) — carry
+    justification pragmas, and ``os._exit`` *references* are findings
+    too (handing the capability around is how it escapes audit).
+    """
+
+    id = "SAV114"
+    name = "bare-exit-in-library"
+    severity = "error"
+    hint = (
+        "raise a typed exception and let the CLI own process exit; the "
+        "watchdog/probe contracts are the only sanctioned library exits "
+        "and carry justification pragmas"
+    )
+
+    EXIT_CALLS = {
+        "sys.exit": "sys.exit() raises SystemExit from library depth",
+        "os._exit": "os._exit() skips every finally/atexit "
+                    "(manifest finalize, checkpoint drain, incident dumps)",
+    }
+    LIBRARY_PREFIX = "sav_tpu/"
+
+    def check(self, module):
+        if not module.relpath.startswith(self.LIBRARY_PREFIX):
+            return  # CLIs and tools legitimately own process exit
+        consumed_funcs = set()
+        for node in ast.walk(module.tree):
+            if isinstance(node, ast.Call):
+                resolved = module.resolve_call(node)
+                if resolved in self.EXIT_CALLS:
+                    consumed_funcs.add(id(node.func))
+                    yield _finding(
+                        self, node,
+                        f"{self.EXIT_CALLS[resolved]} — library code must "
+                        "raise, not exit",
+                    )
+            elif isinstance(node, ast.Raise):
+                exc = node.exc
+                name = None
+                if isinstance(exc, ast.Call) and isinstance(
+                    exc.func, ast.Name
+                ):
+                    name = exc.func.id
+                elif isinstance(exc, ast.Name):
+                    name = exc.id
+                if name == "SystemExit":
+                    yield _finding(
+                        self, node,
+                        "raise SystemExit in library code — callers' "
+                        "except-Exception blocks never see it; raise a "
+                        "typed error and let the CLI exit",
+                    )
+        for node in ast.walk(module.tree):
+            # Bare references (default args, callbacks): handing the
+            # hard-exit capability around is how it escapes audit.
+            if (
+                isinstance(node, (ast.Attribute, ast.Name))
+                and id(node) not in consumed_funcs
+                and module.resolve(node) in self.EXIT_CALLS
+            ):
+                yield _finding(
+                    self, node,
+                    f"reference to {module.resolve(node)} in library code "
+                    "— the exit capability itself needs a pragma'd "
+                    "contract, not a pass-around",
+                )
+
+
 # ----------------------------------------------------------- SAV100 (meta)
 
 
@@ -1111,6 +1197,7 @@ ALL_RULES = [
     RecorderHotLoopSync(),
     FleetHotPathSync(),
     ProfilerInHotPath(),
+    BareExitInLibrary(),
 ]
 
 

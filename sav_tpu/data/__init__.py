@@ -1,40 +1,42 @@
-from sav_tpu.data.augment_spec import AugmentSpec, parse_augment_spec
-from sav_tpu.data.feeder import DeviceFeeder
-from sav_tpu.data.native_loader import (
-    PrefetchLoader,
-    native_available,
+"""Input pipeline package.
+
+Re-exports are fully lazy (PEP 562 via :mod:`sav_tpu._lazy`, like
+:mod:`sav_tpu.obs` / :mod:`sav_tpu.train`): the pipeline's TF import and
+the feeder/records jax imports load on first use, so jax-free consumers
+— the elasticity supervisor's chaos harness recomputing
+:func:`synth_batch` fingerprints in the *parent* process of an on-chip
+job, laptop report tooling — can import :mod:`sav_tpu.data.synthetic`
+(numpy-only) without dragging a backend in.
+"""
+
+from __future__ import annotations
+
+from sav_tpu._lazy import install_lazy_exports
+
+_EXPORTS = {
+    "AugmentSpec": "sav_tpu.data.augment_spec",
+    "parse_augment_spec": "sav_tpu.data.augment_spec",
+    "DeviceFeeder": "sav_tpu.data.feeder",
+    "PrefetchLoader": "sav_tpu.data.native_loader",
+    "native_available": "sav_tpu.data.native_loader",
+    "SavRecDataset": "sav_tpu.data.records",
+    "write_savrec": "sav_tpu.data.records",
+    "savrec_epoch_iterator": "sav_tpu.data.records",
+    "host_shard_indices": "sav_tpu.data.records",
+    "fake_data_iterator": "sav_tpu.data.synthetic",
+    "synthetic_data_iterator": "sav_tpu.data.synthetic",
+    "synth_batch": "sav_tpu.data.synthetic",
+    "synth_resumable_iterator": "sav_tpu.data.synthetic",
+    "load": "sav_tpu.data.pipeline",
+    "Split": "sav_tpu.data.pipeline",
+    "resumable_train_iterator": "sav_tpu.data.pipeline",
+}
+
+__all__ = list(_EXPORTS)
+
+__getattr__, __dir__ = install_lazy_exports(
+    globals(),
+    _EXPORTS,
+    {"augment_spec", "constants", "feeder", "native_loader", "pipeline",
+     "records", "synthetic"},
 )
-from sav_tpu.data.records import (
-    SavRecDataset,
-    host_shard_indices,
-    savrec_epoch_iterator,
-    write_savrec,
-)
-from sav_tpu.data.synthetic import fake_data_iterator, synthetic_data_iterator
-
-__all__ = [
-    "AugmentSpec",
-    "parse_augment_spec",
-    "DeviceFeeder",
-    "PrefetchLoader",
-    "native_available",
-    "SavRecDataset",
-    "write_savrec",
-    "savrec_epoch_iterator",
-    "host_shard_indices",
-    "fake_data_iterator",
-    "synthetic_data_iterator",
-    "load",
-    "Split",
-    "resumable_train_iterator",
-]
-
-
-def __getattr__(name):
-    # pipeline (and its TF import) loads lazily so fake/synthetic paths work
-    # in TF-free contexts.
-    if name in ("load", "Split", "resumable_train_iterator"):
-        from sav_tpu.data import pipeline
-
-        return getattr(pipeline, name)
-    raise AttributeError(name)

@@ -33,6 +33,69 @@ def fake_data_iterator(
         yield {"images": images, "labels": labels}
 
 
+def synth_batch(
+    *,
+    seed: int,
+    position: int,
+    batch_size: int,
+    image_size: int = 32,
+    num_classes: int = 10,
+    dtype=np.float32,
+) -> dict:
+    """The deterministic synthetic batch at schedule ``position``.
+
+    Counter-based (Philox keyed on ``(seed, position)``): the batch is a
+    pure function of its schedule position, independent of iteration
+    history — which makes the stream *resumable by construction* (restart
+    at any step and the batches match the uninterrupted run bit-for-bit)
+    and lets an external verifier (tools/chaos_soak.py) recompute any
+    position's batch, fingerprint it with the flight recorder's blake2b
+    machinery, and prove a resumed child picked up step-exact. The class
+    id is embedded as a brightness offset (the learnable signal the
+    train-step tests rely on), so loss curves carry information.
+
+    Positions are 1-indexed completed-step numbers, matching the
+    recorder's ring entries and ``--skip-steps`` semantics.
+    """
+    key = np.array([seed & 0xFFFFFFFFFFFFFFFF, position], np.uint64)
+    rng = np.random.Generator(np.random.Philox(key=key))
+    labels = rng.integers(0, num_classes, (batch_size,), dtype=np.int32)
+    images = rng.standard_normal(
+        (batch_size, image_size, image_size, 3)
+    ).astype(np.float32)
+    images += (labels[:, None, None, None] / num_classes - 0.5) * 4.0
+    return {"images": images.astype(dtype), "labels": labels}
+
+
+def synth_resumable_iterator(
+    *,
+    seed: int,
+    start_step: int = 0,
+    batch_size: int,
+    image_size: int = 32,
+    num_classes: int = 10,
+    num_batches: Optional[int] = None,
+    dtype=np.float32,
+) -> Iterator[dict]:
+    """Infinite (or bounded) stream of :func:`synth_batch` batches from
+    position ``start_step + 1`` on — the ``train.py --synth-data`` feed:
+    a TF-free, preemption-exact data path for elasticity soaks and
+    kill-resume tests (docs/elasticity.md)."""
+    position = start_step
+    produced = 0
+    while num_batches is None or produced < num_batches:
+        position += 1
+        produced += 1
+        yield synth_batch(
+            seed=seed,
+            position=position,
+            batch_size=batch_size,
+            image_size=image_size,
+            num_classes=num_classes,
+            dtype=dtype,
+        )
+
+
 def synthetic_data_iterator(
     *,
     batch_size: int,

@@ -44,6 +44,8 @@ stay importable without dragging ``jax`` into the process.
 
 from __future__ import annotations
 
+from sav_tpu._lazy import install_lazy_exports
+
 _EXPORTS = {
     "diagnostics_metrics": "sav_tpu.obs.diagnostics",
     "grad_group_norms": "sav_tpu.obs.diagnostics",
@@ -71,26 +73,9 @@ _EXPORTS = {
 
 __all__ = list(_EXPORTS)
 
-_SUBMODULES = frozenset(
+__getattr__, __dir__ = install_lazy_exports(
+    globals(),
+    _EXPORTS,
     {"diagnostics", "spans", "goodput", "memory", "watchdog", "costs",
-     "manifest", "recorder"}
+     "manifest", "recorder"},
 )
-
-
-def __getattr__(name: str):
-    import importlib
-
-    if name in _SUBMODULES:
-        module = importlib.import_module(f"sav_tpu.obs.{name}")
-        globals()[name] = module
-        return module
-    target = _EXPORTS.get(name)
-    if target is None:
-        raise AttributeError(f"module 'sav_tpu.obs' has no attribute {name!r}")
-    value = getattr(importlib.import_module(target), name)
-    globals()[name] = value
-    return value
-
-
-def __dir__():
-    return sorted(set(globals()) | set(__all__))

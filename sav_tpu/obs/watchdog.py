@@ -70,7 +70,12 @@ class HangWatchdog:
     ``os._exit`` nothing gets another chance. The dump runs on a side
     thread bounded by ``dump_timeout_s`` (default 30 s): the log dir's
     filesystem may be the hang's own cause, and the guaranteed-exit
-    contract outranks telemetry. ``exit_fn``/``stream`` are
+    contract outranks telemetry. ``checkpointer``: optional
+    :class:`~sav_tpu.train.checkpoint.Checkpointer` whose in-flight
+    async save is drained (bounded the same way) before the exit —
+    ``os._exit`` skips ``fit()``'s finally, and an abandoned save is
+    wall time the next attempt re-pays (docs/elasticity.md).
+    ``exit_fn``/``stream`` are
     injectable for tests — production uses ``os._exit`` so a wedged main
     thread cannot swallow the abort.
 
@@ -96,6 +101,7 @@ class HangWatchdog:
         ledger=None,
         manifest=None,
         recorder=None,
+        checkpointer=None,
         tag: str = "watchdog",
         exit_code: int = WATCHDOG_EXIT_CODE,
         exit_fn: Optional[Callable[[int], None]] = None,
@@ -120,9 +126,10 @@ class HangWatchdog:
         self.ledger = ledger
         self.manifest = manifest
         self.recorder = recorder
+        self.checkpointer = checkpointer
         self.tag = tag
         self.exit_code = exit_code
-        self._exit_fn = exit_fn if exit_fn is not None else os._exit
+        self._exit_fn = exit_fn if exit_fn is not None else os._exit  # savlint: disable=SAV114 -- THE sanctioned hard-exit contract: a wedged main thread cannot be unwound, and manifest/recorder/checkpoint drains run bounded above before _fire exits
         self._stream = stream
         self._poll_s = poll_s if poll_s is not None else min(deadline_s / 4, 5.0)
         self._dump_timeout_s = dump_timeout_s
@@ -301,6 +308,28 @@ class HangWatchdog:
             elif incident_path:
                 print(
                     f"{self.tag}: incident bundle: {incident_path}",
+                    file=stream,
+                )
+        if self.checkpointer is not None:
+            # Drain any in-flight async checkpoint save before os._exit
+            # abandons it (fit()'s finally never runs on this path). The
+            # checkpointer's own wait(timeout_s) bounds the drain on a
+            # side thread — a hang whose cause IS the checkpoint
+            # filesystem must not stall the exit-4 contract.
+            try:
+                if not self.checkpointer.wait(
+                    timeout_s=self._dump_timeout_s
+                ):
+                    print(
+                        f"{self.tag}: in-flight checkpoint save still "
+                        f"unfinished after {self._dump_timeout_s:.0f}s; "
+                        "aborting without it (the previous committed "
+                        "step remains restorable)",
+                        file=stream,
+                    )
+            except Exception as e:
+                print(
+                    f"{self.tag}: checkpoint drain failed: {e!r}",
                     file=stream,
                 )
         try:

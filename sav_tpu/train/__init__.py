@@ -1,23 +1,37 @@
-from sav_tpu.train.checkpoint import Checkpointer
-from sav_tpu.train.config import TrainConfig
-from sav_tpu.train.optimizer import (
-    make_optimizer,
-    warmup_cosine_schedule,
-    weight_decay_mask,
-)
-from sav_tpu.train.presets import get_preset, preset_names, register_preset
-from sav_tpu.train.state import TrainState
-from sav_tpu.train.trainer import Trainer
+"""Training stack — pjit trainer, config, schedules, checkpointing,
+and the elastic-training supervisor.
 
-__all__ = [
-    "Checkpointer",
-    "TrainConfig",
-    "TrainState",
-    "Trainer",
-    "make_optimizer",
-    "warmup_cosine_schedule",
-    "weight_decay_mask",
-    "get_preset",
-    "preset_names",
-    "register_preset",
-]
+Re-exports are lazy (PEP 562 via :mod:`sav_tpu._lazy`, the same pattern
+as :mod:`sav_tpu.obs` / :mod:`sav_tpu.utils`):
+:mod:`sav_tpu.train.supervisor` is stdlib-only by contract (it runs in
+the parent of on-chip jobs, where importing the backend is exactly what
+hangs — see ``utils.backend_probe``), so the package import must not
+drag jax/orbax in eagerly.
+"""
+
+from __future__ import annotations
+
+from sav_tpu._lazy import install_lazy_exports
+
+_EXPORTS = {
+    "Checkpointer": "sav_tpu.train.checkpoint",
+    "TrainConfig": "sav_tpu.train.config",
+    "TrainState": "sav_tpu.train.state",
+    "Trainer": "sav_tpu.train.trainer",
+    "make_optimizer": "sav_tpu.train.optimizer",
+    "warmup_cosine_schedule": "sav_tpu.train.optimizer",
+    "weight_decay_mask": "sav_tpu.train.optimizer",
+    "get_preset": "sav_tpu.train.presets",
+    "preset_names": "sav_tpu.train.presets",
+    "register_preset": "sav_tpu.train.presets",
+    "Supervisor": "sav_tpu.train.supervisor",
+}
+
+__all__ = list(_EXPORTS)
+
+__getattr__, __dir__ = install_lazy_exports(
+    globals(),
+    _EXPORTS,
+    {"checkpoint", "config", "optimizer", "presets", "state", "supervisor",
+     "trainer"},
+)

@@ -135,6 +135,21 @@ class TrainConfig:
     # Logging / checkpointing
     eval_every_epochs: int = 5
     checkpoint_every_epochs: int = 10
+    # Step-granular checkpoint cadences (docs/elasticity.md): save every
+    # N completed steps and/or every T seconds, in ADDITION to the epoch
+    # cadence. Saves fire at the trainer's log boundary — the step's
+    # metrics sync already drained the pipeline there, and Orbax's async
+    # checkpointing writes on the side — so a cadence adds no step-time
+    # pause beyond the host-memory copy; both cadences count from the
+    # LAST save, quantized up to the next log boundary (a misaligned
+    # log_every_steps coarsens a save by at most one log window, never
+    # to the lcm). This is what makes resume
+    # step-exact mid-epoch (the resumable data stream replays from the
+    # restored step; rng is a pure function of (seed, step)): without a
+    # step cadence a preemption loses up to checkpoint_every_epochs of
+    # work. None disables either cadence.
+    checkpoint_every_steps: Optional[int] = None
+    checkpoint_every_secs: Optional[float] = None
     checkpoint_dir: Optional[str] = None
     checkpoint_keep: int = 3
     log_every_steps: int = 100

@@ -170,6 +170,32 @@ register_preset(
     augment="",
 )
 
+# Elasticity smoke (docs/elasticity.md): the chaos-soak / kill-resume
+# child — a 2-layer ViT small enough that a CPU attempt restarts in
+# seconds, float32 so resumed loss curves are bit-comparable against an
+# uninterrupted reference, a long epoch (1000 steps) so every soak kill
+# lands mid-epoch, and a tight log cadence so heartbeats (the supervisor's
+# progress/goodput source) land every 2 steps. Pair with
+# ``--synth-data --checkpoint-every-steps N`` on the CLI.
+register_preset(
+    "elastic_smoke",
+    model_name="vit_ti_patch16",
+    model_overrides=dict(num_layers=2, embed_dim=64, num_heads=4),
+    num_classes=10,
+    image_size=32,
+    compute_dtype="float32",
+    global_batch_size=8,
+    num_train_images=8 * 1000,
+    num_epochs=1,
+    warmup_epochs=0,
+    base_lr=1e-3,
+    lr_scaling_divisor=8,
+    transpose_images=False,
+    augment="",
+    log_every_steps=2,
+    seed=0,
+)
+
 # The RESULTS.md record run: scikit-learn digits as ImageNet-layout
 # TFRecords (tools/make_digits_tfrecords.py), trained through the full real
 # path to 85%+ top-1 from scratch (reproduced twice). Two knobs live on the

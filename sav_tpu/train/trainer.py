@@ -212,23 +212,35 @@ class Trainer:
             # Flat Adam moments can't be sharded like their parameters —
             # auto-enable only when params are replicated (no non-data axis).
             fused_opt = all(name == "data" for name in self.mesh.axis_names)
-        self.tx = make_optimizer(
-            self.schedule,
-            weight_decay=config.weight_decay,
-            clip_grad_norm=config.clip_grad_norm,
-            fused=fused_opt,
-            ema_decay=config.ema_decay,
-        )
+        self._build_optimizer(fused_opt)
         self.checkpointer = checkpointer
         if checkpointer is None and config.checkpoint_dir:
             self.checkpointer = Checkpointer(
                 config.checkpoint_dir, keep=config.checkpoint_keep
             )
-        self._train_step = jax.jit(self._train_step_impl, donate_argnums=(0,))
-        self._train_many = jax.jit(self._train_many_impl, donate_argnums=(0,))
         self._eval_step = jax.jit(self._eval_step_impl)
         # Goodput ledger summary of the most recent fit() (sav_tpu.obs).
         self.last_goodput: Optional[dict] = None
+
+    def _build_optimizer(self, fused: bool) -> None:
+        """(Re)build the optax chain + the jitted step programs.
+
+        Split out of ``__init__`` so :meth:`restore_or_init` can swap the
+        optimizer *layout* (per-leaf vs flat Adam moments) to match a
+        probed checkpoint before building the restore template — the
+        numerics are identical (``optax.flatten`` is a reshape), only the
+        opt-state pytree structure changes.
+        """
+        self.fused_optimizer = fused
+        self.tx = make_optimizer(
+            self.schedule,
+            weight_decay=self.config.weight_decay,
+            clip_grad_norm=self.config.clip_grad_norm,
+            fused=fused,
+            ema_decay=self.config.ema_decay,
+        )
+        self._train_step = jax.jit(self._train_step_impl, donate_argnums=(0,))
+        self._train_many = jax.jit(self._train_many_impl, donate_argnums=(0,))
 
     # ------------------------------------------------------------------ init
 
@@ -376,7 +388,73 @@ class Trainer:
             params=params, batch_stats=stats, opt_state=opt_state
         )
 
+    def _match_checkpoint_layout(self) -> None:
+        """Probe the saved opt-state layout and pick the matching
+        optimizer build (docs/elasticity.md).
+
+        Resuming a pre-round-3 checkpoint used to require a hand-passed
+        ``--no-fused-optimizer``; the checkpoint itself already knows its
+        layout, so when ``config.fused_optimizer`` is None (auto) the
+        probe's answer wins and the optimizer is rebuilt to match. An
+        *explicit* config that contradicts the checkpoint is kept — the
+        user overrode auto on purpose — but warned about, because the
+        restore is then going to fail with a structure mismatch.
+        """
+        import logging
+
+        layout = self.checkpointer.opt_layout()
+        detected = layout.get("fused")
+        if detected is not None and detected != self.fused_optimizer:
+            pure_data = all(name == "data" for name in self.mesh.axis_names)
+            if self.config.fused_optimizer is None:
+                if detected and not pure_data:
+                    # Auto-detect must not override the __init__ mesh
+                    # guard: flat Adam moments cannot take non-data
+                    # parameter shardings, so a fused-layout checkpoint
+                    # cannot be resumed onto this mesh either way —
+                    # keep per-leaf and let the restore fail loudly.
+                    logging.warning(
+                        "checkpoint uses the flat-buffer optimizer-state "
+                        "layout but the mesh has non-data axes %s (flat "
+                        "moments cannot shard like their parameters); "
+                        "keeping the per-leaf build — restore will fail; "
+                        "resume on the checkpoint's original mesh layout",
+                        list(self.mesh.axis_names),
+                    )
+                    return
+                logging.warning(
+                    "checkpoint uses the %s optimizer-state layout; "
+                    "rebuilding the optimizer to match (auto-detected — "
+                    "pass --%sfused-optimizer to silence)",
+                    "flat-buffer" if detected else "per-leaf",
+                    "" if detected else "no-",
+                )
+                self._build_optimizer(detected)
+            else:
+                logging.warning(
+                    "config.fused_optimizer=%s but the checkpoint's "
+                    "opt-state layout is %s — restore will fail with a "
+                    "structure mismatch unless the flag matches the "
+                    "checkpoint",
+                    self.config.fused_optimizer,
+                    "flat-buffer" if detected else "per-leaf",
+                )
+        if layout.get("ema") is not None and bool(layout.get("ema")) != (
+            self.config.ema_decay is not None
+        ):
+            logging.warning(
+                "checkpoint %s a parameter-EMA tree but config.ema_decay "
+                "is %s — restore will fail with a structure mismatch "
+                "unless --ema-decay matches the checkpointed run",
+                "carries" if layout.get("ema") else "lacks",
+                self.config.ema_decay,
+            )
+
     def restore_or_init(self) -> TrainState:
+        if self.checkpointer is not None and self.checkpointer.latest_step() is not None:
+            # Layout probe BEFORE the template is built: the template's
+            # opt-state structure must match the saved one.
+            self._match_checkpoint_layout()
         state = self.init_state()
         if self.checkpointer is not None:
             try:
@@ -866,6 +944,51 @@ class Trainer:
                 )
         return results
 
+    def _save_with_stamp(self, step: int, state: TrainState) -> None:
+        """One checkpoint save + the resume stamp (docs/elasticity.md).
+
+        ``resume.json`` persists the full mid-epoch resume recipe next to
+        the checkpoints — ``(epoch, step-in-epoch, rng derivation, feeder
+        position)`` — as auditable provenance: the checkpoint's own
+        ``state.step`` stays authoritative (the resumable data stream and
+        the rng are both pure functions of ``(seed, step)``), and the
+        stamp lets supervisors/post-mortems read the resume point without
+        orbax. Advisory by design: the stamp is written when the async
+        save is *requested*; a preemption between request and commit
+        leaves a stamp one save ahead, which readers must treat as an
+        upper bound.
+        """
+        self.checkpointer.save(step, state)
+        cfg = self.config
+        spe = max(cfg.steps_per_epoch, 1)
+        stamp = {
+            "schema": 1,
+            "step": int(step),
+            "epoch": int(step // spe),
+            "step_in_epoch": int(step % spe),
+            "steps_per_epoch": spe,
+            "seed": cfg.seed,
+            # Batches consumed == steps on the EFFECTIVE schedule;
+            # rewind-and-skip shifts the original-schedule position
+            # (train.py's resume_schedule_position + notes.rewind_skip
+            # carry the audit).
+            "feeder_position": int(step),
+            "rng": {
+                "derivation":
+                    "jax.random.fold_in(jax.random.PRNGKey(seed), 1), "
+                    "then fold_in(rng, state.step) inside the step",
+            },
+            "saved_unix": round(time.time(), 3),
+        }
+        path = os.path.join(self.checkpointer.directory, "resume.json")
+        try:
+            tmp = f"{path}.tmp.{os.getpid()}"
+            with open(tmp, "w") as f:
+                json.dump(stamp, f, indent=2)
+            os.replace(tmp, path)
+        except OSError:
+            pass  # provenance, never fatal
+
     def fit(
         self,
         train_iter: Iterator[dict],
@@ -1065,6 +1188,10 @@ class Trainer:
             watchdog = HangWatchdog(
                 cfg.watchdog_secs, ledger=ledger, tag="train-watchdog",
                 manifest=manifest, recorder=recorder,
+                # Pre-exit drain of any in-flight async save (bounded):
+                # os._exit skips fit()'s finally, and a save abandoned
+                # mid-commit is work the next attempt re-pays.
+                checkpointer=self.checkpointer,
                 soft_deadline_s=cfg.watchdog_soft_secs,
                 on_soft=_on_watchdog_soft,
             )
@@ -1163,6 +1290,9 @@ class Trainer:
         t_last = time.time()
         last_logged_step = start_step
         last_saved_step = None
+        # Wall anchor for the checkpoint_every_secs cadence; reset on
+        # every save so epoch/step-cadence saves push the timer out.
+        t_last_ckpt = time.time()
         # jax.profiler trace window (SURVEY.md §5): capture a few steady-state
         # steps, skipping compile/warmup. Relative to start_step so resumed
         # runs still profile.
@@ -1420,6 +1550,37 @@ class Trainer:
                                 and recorder.incidents else None
                             ),
                         )
+                    if self.checkpointer is not None and (
+                        step + 1
+                    ) != last_saved_step:
+                        # Step-granular cadences (docs/elasticity.md):
+                        # piggyback on the log boundary — the metrics
+                        # sync above already drained the pipeline, and
+                        # Orbax's async path writes on the side, so the
+                        # cadence adds no step-time pause of its own.
+                        # Steps-since-last-save (NOT a step-number
+                        # modulo, which would only ever fire at
+                        # lcm(N, log_every_steps) when the cadences
+                        # misalign): the save lands at the first log
+                        # boundary >= N steps after the previous save.
+                        since_save = (step + 1) - (
+                            last_saved_step
+                            if last_saved_step is not None else start_step
+                        )
+                        due = (
+                            cfg.checkpoint_every_steps
+                            and since_save >= cfg.checkpoint_every_steps
+                        ) or (
+                            cfg.checkpoint_every_secs is not None
+                            and now - t_last_ckpt
+                            >= cfg.checkpoint_every_secs
+                        )
+                        if due:
+                            with tracer.span("checkpoint", step=step + 1), \
+                                    ledger.measure("checkpoint"):
+                                self._save_with_stamp(step + 1, state)
+                            last_saved_step = step + 1
+                            t_last_ckpt = time.time()
                 epoch_done = (step + 1) % cfg.steps_per_epoch == 0
                 if epoch_done:
                     epoch = (step + 1) // cfg.steps_per_epoch
@@ -1436,11 +1597,13 @@ class Trainer:
                     if (
                         self.checkpointer is not None
                         and epoch % cfg.checkpoint_every_epochs == 0
+                        and (step + 1) != last_saved_step
                     ):
                         with tracer.span("checkpoint", step=step + 1), \
                                 ledger.measure("checkpoint"):
-                            self.checkpointer.save(step + 1, state)
+                            self._save_with_stamp(step + 1, state)
                         last_saved_step = step + 1
+                        t_last_ckpt = time.time()
                     # Reset the throughput window so eval/checkpoint wall time
                     # doesn't deflate the next logged images_per_sec.
                     t_last = time.time()
@@ -1478,7 +1641,7 @@ class Trainer:
                 if last_saved_step != num_steps:
                     with tracer.span("checkpoint", step=num_steps), \
                             ledger.measure("checkpoint"):
-                        self.checkpointer.save(num_steps, state)
+                        self._save_with_stamp(num_steps, state)
                 with ledger.measure("checkpoint"):
                     self.checkpointer.wait()
         finally:
@@ -1541,6 +1704,23 @@ class Trainer:
                 feeder.close()
             if watchdog is not None:
                 watchdog.stop()
+            if self.checkpointer is not None:
+                # Abnormal exits must not abandon an in-flight async
+                # save: Orbax commits by atomic rename, so an un-awaited
+                # save is *lost* (re-paid by the next attempt), never
+                # torn — but draining it here keeps the newest step. The
+                # wait is BOUNDED (a crash escaping a wedged filesystem
+                # must not inherit the very hang it is escaping) and runs
+                # AFTER the watchdog disarms, so a slow drain on a crash
+                # path cannot be misclassified as a steady-state hang.
+                with ledger.measure("checkpoint"):
+                    if not self.checkpointer.wait(timeout_s=120.0):
+                        print(
+                            "trainer: in-flight checkpoint save still "
+                            "unfinished after 120s; abandoning it (the "
+                            "previous committed step remains restorable)",
+                            file=sys.stderr,
+                        )
             if autoprof is not None:
                 # A crash (or normal exit) inside a capture window still
                 # leaves a finished, manifest-stamped trace behind — at

@@ -8,6 +8,8 @@ import delays the abort decision.
 
 from __future__ import annotations
 
+from sav_tpu._lazy import install_lazy_exports
+
 _EXPORTS = {
     "topk_correct": "sav_tpu.utils.metrics",
     "accuracy_topk": "sav_tpu.utils.metrics",
@@ -34,29 +36,9 @@ _EXPORTS = {
 __all__ = list(_EXPORTS)
 
 
-_SUBMODULES = frozenset(
+__getattr__, __dir__ = install_lazy_exports(
+    globals(),
+    _EXPORTS,
     {"backend_probe", "debug", "metrics", "param_overview", "profiler",
-     "writers"}
+     "writers"},
 )
-
-
-def __getattr__(name: str):
-    import importlib
-
-    if name in _SUBMODULES:
-        # Eager imports used to bind submodules as package attributes
-        # (`sav_tpu.utils.metrics` after `import sav_tpu.utils`); keep that
-        # working lazily too.
-        module = importlib.import_module(f"sav_tpu.utils.{name}")
-        globals()[name] = module
-        return module
-    target = _EXPORTS.get(name)
-    if target is None:
-        raise AttributeError(f"module 'sav_tpu.utils' has no attribute {name!r}")
-    value = getattr(importlib.import_module(target), name)
-    globals()[name] = value
-    return value
-
-
-def __dir__():
-    return sorted(set(globals()) | set(__all__))

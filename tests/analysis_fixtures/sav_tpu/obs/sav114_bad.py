@@ -1,0 +1,24 @@
+"""Known-bad fixture for SAV114: bare process exits in library code —
+a sys.exit on a validation failure, an os._exit from a monitor thread,
+a raise SystemExit masquerading as error handling, and the os._exit
+capability handed around as a callback default."""
+import os
+import sys
+
+
+def validate_config(config):
+    if config is None:
+        sys.exit(2)
+    return config
+
+
+def monitor(deadline, exit_fn=os._exit):
+    if deadline <= 0:
+        os._exit(4)
+    return exit_fn
+
+
+def load_or_die(path):
+    if not os.path.exists(path):
+        raise SystemExit(1)
+    return open(path)

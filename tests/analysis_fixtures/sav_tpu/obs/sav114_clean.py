@@ -1,0 +1,28 @@
+"""Known-clean fixture for SAV114: the legitimate idioms — library code
+raising typed exceptions for the CLI to map to exit codes, an injectable
+exit_fn that defaults to a test-friendly callable, and the pragma'd
+sanctioned contract."""
+import os
+
+
+class BackendUnreachableError(RuntimeError):
+    """Typed error the CLI maps to its exit-3 contract."""
+
+
+def validate_config(config):
+    if config is None:
+        raise ValueError("config must not be None")
+    return config
+
+
+def require_backend(platform):
+    if platform is None:
+        # Raise; train.py/bench.py own the process exit code.
+        raise BackendUnreachableError("backend unreachable")
+    return platform
+
+
+class Watchdog:
+    def __init__(self, exit_fn=None):
+        # The one sanctioned hard-exit contract, pragma'd with the why.
+        self._exit_fn = exit_fn if exit_fn is not None else os._exit  # savlint: disable=SAV114 -- sanctioned watchdog contract: a wedged main thread cannot be unwound

@@ -105,6 +105,12 @@ BAD_EXPECTATIONS = {
         ("SAV113", 22),  # live_buffer_ranking in evaluate()
         ("SAV113", 26),  # memdump inside train_step_placed()
     ],
+    "sav_tpu/obs/sav114_bad.py": [
+        ("SAV114", 11),  # sys.exit on a validation failure
+        ("SAV114", 15),  # os._exit handed around as a callback default
+        ("SAV114", 17),  # os._exit from a monitor path
+        ("SAV114", 23),  # raise SystemExit as error handling
+    ],
 }
 
 CLEAN_FIXTURES = [
@@ -121,6 +127,7 @@ CLEAN_FIXTURES = [
     "sav111_clean.py",
     "sav112_clean.py",
     "sav113_clean.py",
+    "sav_tpu/obs/sav114_clean.py",
 ]
 
 

@@ -76,6 +76,30 @@ def test_trainer_hot_loop_suppressions_are_the_known_set():
     assert len(suppressed) == 14
 
 
+def test_library_exit_suppressions_are_the_two_contracts():
+    """SAV114's sanctioned library exits stay exactly the documented
+    pair (docs/elasticity.md exit-code table): the watchdog's os._exit
+    capability and the backend probe's SystemExit(3). A third bare exit
+    in sav_tpu/ must extend this consciously, not ride in on a pragma."""
+    paths = [
+        os.path.join(ROOT, "sav_tpu", "obs", "watchdog.py"),
+        os.path.join(ROOT, "sav_tpu", "utils", "backend_probe.py"),
+    ]
+    result = lint_paths(paths, root=ROOT)
+    assert result.findings == []
+    sav114 = [f for f in result.suppressed if f.rule == "SAV114"]
+    assert sorted(os.path.basename(f.path) for f in sav114) == [
+        "backend_probe.py", "watchdog.py",
+    ]
+    # The supervisor itself — the layer most tempted to exit — never
+    # does: it RETURNS exit codes (train.py owns the process exit).
+    sup = lint_paths(
+        [os.path.join(ROOT, "sav_tpu", "train", "supervisor.py")], root=ROOT
+    )
+    assert sup.findings == []
+    assert [f for f in sup.suppressed if f.rule == "SAV114"] == []
+
+
 # ------------------------------------------------- the gate actually bites
 
 

@@ -263,10 +263,39 @@ def main(argv=None) -> int:
     summary = aggregate_fleet(args.log_dir, straggler_k=args.straggler_k)
     summary["autoprof"] = autoprof_captures(args.log_dir)
     summary["probe_timeline"] = read_probe_timeline(args.log_dir)
+    # Supervised runs (train.py --supervise, docs/elasticity.md): fold
+    # the restart chain's headline into the fleet view — the heartbeat
+    # streams this tool reads span ALL attempts, and a reader should
+    # know they are looking at a chain, not one process lifetime.
+    from sav_tpu.train.supervisor import load_chain  # stdlib-only module
+
+    chain_doc = load_chain(args.log_dir)
+    if chain_doc is not None:
+        chain = (chain_doc.get("notes") or {}).get("chain") or {}
+        summary["supervisor"] = {
+            "outcome": chain_doc.get("outcome"),
+            "attempts": len(chain.get("attempts") or []),
+            "restart_reasons": [
+                a.get("restart_reason")
+                for a in (chain.get("attempts") or [])
+                if a.get("restart_reason")
+            ],
+            "goodput": chain.get("goodput"),
+            "skipped_steps": chain.get("skipped_steps"),
+        }
     if args.json:
         print(json.dumps(summary, indent=2, default=str))
     else:
         render(args.log_dir, summary, sys.stdout)
+        sup = summary.get("supervisor")
+        if sup is not None:
+            gp = sup.get("goodput") or {}
+            print(
+                f"Supervisor chain: {sup['attempts']} attempt(s), outcome "
+                f"{sup['outcome']}, restarts {sup['restart_reasons']}, "
+                f"goodput {gp.get('goodput_frac', 0.0):.1%} "
+                f"(render with tools/run_report.py --chain)"
+            )
     return 0
 
 

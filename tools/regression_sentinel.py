@@ -21,7 +21,9 @@ Tracked metrics: ``throughput`` (img/s/chip, higher is better), ``mfu``
 (higher), ``input_wait_frac`` (share of wall time blocked on input,
 lower), ``attention_core_frac`` (measured attention-core share of
 device time from ``bench.py --trace``, lower — present only on traced
-benches; untraced records are skipped, not zero-filled). Infra failures
+benches; untraced records are skipped, not zero-filled),
+``goodput_frac`` (elastic-training goodput from supervisor manifest
+chains, higher — supervised runs only, docs/elasticity.md). Infra failures
 are *reported but never scored* — a down relay is
 not a regression (the BENCH_r05 lesson), and a history whose only deltas
 are infra failures exits clean.
@@ -73,6 +75,13 @@ METRICS = {
     # hides it. Absolute floor: two points of step share, same rationale
     # as input_wait_frac's (a flat history must not flag jitter).
     "attention_core_frac": (False, 0.02),
+    # Elastic-training goodput fraction (supervisor manifest chains,
+    # docs/elasticity.md): 1 − (lost + restart-backoff)/wall. Higher is
+    # better — a drop means preemptions started costing real wall time
+    # (checkpoint cadence too coarse, restarts thrashing). Present only
+    # on supervised runs; unsupervised records are skipped, not
+    # zero-filled. Absolute floor: one point of wall share.
+    "goodput_frac": (True, 0.01),
 }
 
 EXIT_CLEAN, EXIT_REGRESSION, EXIT_USAGE = 0, 1, 2

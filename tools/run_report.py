@@ -17,6 +17,12 @@ healthy inputs: ``rc != 0`` / ``parsed: null`` records land in an "infra
 failures" section instead of crashing the report or being silently
 skipped (the BENCH_r05 lesson).
 
+``--chain`` (or any log dir with a ``supervisor.json``) renders the
+elastic-training supervisor's manifest chain (docs/elasticity.md):
+attempts, restart reasons, resumed-from steps, lost time, skipped
+batches, and the goodput accounting; single-attempt and unsupervised
+runs degrade gracefully.
+
 ``--incidents`` (or any log dir that has an ``incidents/`` directory)
 renders the flight recorder's bundles (``sav_tpu/obs/recorder.py``,
 docs/incident_replay.md): step, trigger, replay window, and — when
@@ -550,6 +556,77 @@ def report_fleet(log_dir: str, out) -> None:
               "(fleet/backend_probe.jsonl)", file=out)
 
 
+def report_chain(log_dir: str, out) -> None:
+    """Render a supervisor manifest chain (docs/elasticity.md):
+    attempts, restart reasons, resumed-from steps, lost time, skipped
+    batches, and the goodput accounting. Degrades gracefully: a
+    single-attempt chain reads as "no restarts", and a run that was
+    never supervised reports that instead of erroring."""
+    path = os.path.join(log_dir, "supervisor.json")
+    if not os.path.exists(path):
+        print(f"(no supervisor chain at {path} — run with --supervise)",
+              file=out)
+        return
+    try:
+        with open(path) as f:
+            doc = json.load(f)
+    except (OSError, json.JSONDecodeError):
+        print(f"Supervisor chain: {path} (unreadable/torn)", file=out)
+        return
+    chain = (doc.get("notes") or {}).get("chain") or {}
+    attempts = chain.get("attempts") or []
+    goodput = chain.get("goodput") or {}
+    outcome = doc.get("outcome", "?")
+    flag = "" if outcome == "ok" else "  <-- NOT ok"
+    print(
+        f"Supervisor chain: {len(attempts)} attempt(s), "
+        f"outcome={outcome}{flag}",
+        file=out,
+    )
+    if doc.get("error"):
+        print(f"  error: {doc['error']}", file=out)
+    for a in attempts:
+        reason = a.get("restart_reason")
+        lost = a.get("lost_s")
+        print(
+            f"  attempt {a.get('attempt')}: steps "
+            f"{a.get('resumed_from_step')} -> {a.get('last_step')}, "
+            f"{_fmt_seconds(a.get('wall_s') or 0.0)} wall, "
+            + (
+                f"lost {_fmt_seconds(lost)}"
+                if isinstance(lost, (int, float)) and lost else "no loss"
+            )
+            + (f"  [{reason}]" if reason else "  [finished]"),
+            file=out,
+        )
+        if a.get("skip_decided"):
+            print(
+                f"    rewind-and-skip decided here: step(s) "
+                f"{a['skip_decided']}",
+                file=out,
+            )
+        if a.get("skip_steps"):
+            print(
+                f"    skip set armed: step(s) {a['skip_steps']}",
+                file=out,
+            )
+    if len(attempts) == 1:
+        print("  (single attempt — no restarts were needed)", file=out)
+    skipped = chain.get("skipped_steps") or []
+    if skipped:
+        print(f"  skipped batches (once each): {skipped}", file=out)
+    if goodput:
+        print(
+            f"  goodput: {goodput.get('goodput_frac', 0.0):.1%} "
+            f"({_fmt_seconds(goodput.get('lost_s', 0.0))} lost + "
+            f"{_fmt_seconds(goodput.get('backoff_s', 0.0))} backoff over "
+            f"{_fmt_seconds(goodput.get('wall_s', 0.0))} wall; "
+            f"accounting covers "
+            f"{goodput.get('accounted_frac', 0.0):.1%})",
+            file=out,
+        )
+
+
 def report_bench_history(paths: list, out) -> int:
     """Render bench-record history; returns a process exit code (2 on
     unreadable input — mirroring the sentinel's usage/IO contract)."""
@@ -614,6 +691,14 @@ def main(argv=None) -> int:
         "autoprof/ directory exists",
     )
     parser.add_argument(
+        "--chain", action="store_true",
+        help="render the log dir's supervisor manifest chain "
+        "(supervisor.json — train.py --supervise; docs/elasticity.md): "
+        "attempts, restart reasons, lost time, skipped batches; also "
+        "rendered automatically when the file exists. Degrades "
+        "gracefully on single-attempt and unsupervised runs.",
+    )
+    parser.add_argument(
         "--incidents", action="store_true",
         help="render the log dir's flight-recorder incident bundles "
         "(<log-dir>/incidents/) with their replay verdicts; incident "
@@ -640,6 +725,10 @@ def main(argv=None) -> int:
         if args.bench is None:
             parser.error("--trace needs a log dir to look under")
         print("(--trace ignored: no log dir given)", file=sys.stderr)
+    if args.chain and args.log_dir is None:
+        if args.bench is None:
+            parser.error("--chain needs a log dir to look under")
+        print("(--chain ignored: no log dir given)", file=sys.stderr)
 
     if args.bench:
         rc = report_bench_history(args.bench, sys.stdout)
@@ -678,6 +767,12 @@ def main(argv=None) -> int:
                     report_manifest(json.load(f), out)
             except json.JSONDecodeError:
                 print(f"Manifest: {manifest_path} (unreadable/torn)", file=out)
+
+    if args.log_dir and (
+        args.chain
+        or os.path.exists(os.path.join(args.log_dir, "supervisor.json"))
+    ):
+        report_chain(args.log_dir, out)
 
     if args.log_dir and (
         args.incidents

@@ -111,6 +111,67 @@ import click
 )
 @click.option("-c", "--checkpoint-dir", type=str, default=None)
 @click.option(
+    "--checkpoint-every-steps", type=int, default=None,
+    help="Step-granular checkpoint cadence (docs/elasticity.md): save "
+    "once >= N steps passed since the last save, in addition to "
+    "--checkpoint-every-epochs. Fires at the log boundary (whose metrics "
+    "sync already drained the pipeline; a misaligned --log cadence "
+    "delays a save by at most one log window) with Orbax async writes — "
+    "no extra step-time pause — and makes resume step-exact mid-epoch.",
+)
+@click.option(
+    "--checkpoint-every-secs", type=float, default=None,
+    help="Wall-clock checkpoint cadence: save when this many seconds "
+    "passed since the last save (checked at log boundaries). Composes "
+    "with the step/epoch cadences; size it to the wall time you can "
+    "afford to re-pay after a preemption.",
+)
+@click.option(
+    "--supervise", is_flag=True,
+    help="Elastic-training supervisor mode (docs/elasticity.md): run "
+    "this same command as a child process under bounded-restart "
+    "supervision — backend-probe exit 3, watchdog exit 4, crashes, and "
+    "signal kills restart with exponential backoff; resume is the "
+    "trainer's own step-exact restore from -c. Writes the manifest "
+    "chain to <log-dir>/supervisor.json (goodput/lost_s accounting, "
+    "rewind-and-skip of nonfinite incident batches). Requires -c. The "
+    "supervisor process never imports jax.",
+)
+@click.option(
+    "--max-restarts", type=int, default=16,
+    help="Supervisor restart budget (attempts = restarts + 1).",
+)
+@click.option(
+    "--restart-backoff", type=float, default=5.0,
+    help="Supervisor restart backoff base, seconds (doubles per "
+    "restart, capped at 300; deterministic — no jitter).",
+)
+@click.option(
+    "--skip-steps", type=str, default=None,
+    help="Rewind-and-skip (docs/elasticity.md): comma-separated "
+    "1-indexed schedule steps whose batches are dropped once — the "
+    "PaLM-style cure for a data-caused NaN. Normally passed by the "
+    "supervisor after a nonfinite incident (the flight recorder's "
+    "bundle names the step); each dropped batch's blake2b fingerprint "
+    "is noted into the manifest (notes.rewind_skip).",
+)
+@click.option(
+    "--synth-data", is_flag=True,
+    help="Deterministic counter-based synthetic batches "
+    "(sav_tpu/data/synthetic.py): each batch is a pure function of "
+    "(seed, step), so the stream is resumable by construction and an "
+    "external verifier can recompute any position's batch hash. TF-free "
+    "— the elasticity soak/kill-resume data path.",
+)
+@click.option(
+    "--debug-nans/--no-debug-nans", default=False,
+    help="Assert every step's metrics are finite (host-side check per "
+    "step — a per-step device sync, debug only): the run dies with "
+    "outcome 'nonfinite' at the exact bad step instead of training on "
+    "through NaN, and with --record the flight recorder dumps the "
+    "offending batch for rewind-and-skip.",
+)
+@click.option(
     "--init-from", type=str, default=None,
     help="Warm-start params/batch_stats from another run's checkpoint dir "
     "(fresh step/optimizer). Cross-resolution finetunes resample the "
@@ -314,6 +375,13 @@ def main(ctx, **kwargs):
     finalizes 'hang' itself before exit 4), and backend-unreachable
     (require_backend_or_exit finalizes before exit 3).
     """
+    if kwargs.get("supervise"):
+        # The supervisor owns <log-dir>/supervisor.json; each child
+        # attempt owns manifest.json. No jax import happens on this
+        # path — the parent of an on-chip job must not be hangable by
+        # the backend (the same philosophy as utils.backend_probe).
+        raise SystemExit(_supervise(kwargs))
+
     from sav_tpu.obs.manifest import RunManifest, classify_exception
 
     # Provisional sink: the same default resolution the config does later
@@ -354,6 +422,46 @@ def main(ctx, **kwargs):
         raise
 
 
+def _supervise(kwargs) -> int:
+    """train.py --supervise: re-run this command (sans supervisor flags)
+    under :class:`sav_tpu.train.supervisor.Supervisor`."""
+    from sav_tpu.train.supervisor import (
+        Supervisor,
+        parse_skip_steps,
+        strip_supervisor_flags,
+    )
+
+    if not kwargs.get("checkpoint_dir"):
+        # Without a checkpoint dir every restart would begin from step 0
+        # — that is a crash loop with extra steps, not elasticity.
+        raise click.UsageError(
+            "--supervise needs -c/--checkpoint-dir: restarts resume from "
+            "its checkpoints"
+        )
+    sink = kwargs.get("log_dir") or kwargs["checkpoint_dir"]
+    # The user's own --skip-steps seeds the supervisor's cumulative skip
+    # ledger instead of riding the child argv: the supervisor re-appends
+    # the full set every attempt, and two --skip-steps flags would
+    # collapse to click's last-value-wins.
+    try:
+        user_skips = parse_skip_steps(kwargs.get("skip_steps"))
+    except ValueError as e:
+        raise click.UsageError(str(e))
+    child_argv = [sys.executable, os.path.abspath(__file__)]
+    child_argv += strip_supervisor_flags(
+        sys.argv[1:], extra_value_flags=("--skip-steps",)
+    )
+    supervisor = Supervisor(
+        child_argv,
+        log_dir=sink,
+        checkpoint_dir=kwargs["checkpoint_dir"],
+        max_restarts=kwargs.get("max_restarts", 16),
+        backoff_base_s=kwargs.get("restart_backoff", 5.0),
+        skip_steps=user_skips,
+    )
+    return supervisor.run()
+
+
 def _run(
     ctx, manifest, data_dir, fake_data, model_name, num_classes, image_size,
     batch_size,
@@ -361,7 +469,9 @@ def _run(
     ema_decay, clip_grad, grad_accum, augmentation, patch_size, backend,
     attn_tune_cache, logits_dtype,
     remat, dtype, tp, fsdp, sp, sp_method, pp, pp_microbatches, preset,
-    checkpoint_dir, init_from,
+    checkpoint_dir, checkpoint_every_steps, checkpoint_every_secs,
+    supervise, max_restarts, restart_backoff, skip_steps, synth_data,
+    debug_nans, init_from,
     eval_only, steps, num_train_images,
     num_eval_images, crop_min_area, train_flip, platform, backend_wait,
     fused_optimizer, log_dir, diagnostics, trace_spans, watchdog_secs,
@@ -402,6 +512,21 @@ def _run(
             "--watchdog-soft-secs needs --watchdog-secs and must be "
             "smaller than it (soft warns, hard aborts)"
         )
+    if synth_data and (fake_data or data_dir):
+        raise click.UsageError(
+            "--synth-data is its own data source; drop --fake-data/--data-dir"
+        )
+    if synth_data and eval_only:
+        raise click.UsageError(
+            "--eval-only has no synthetic eval split; use --fake-data or "
+            "a real --data-dir"
+        )
+    from sav_tpu.train.supervisor import parse_skip_steps
+
+    try:
+        skip = parse_skip_steps(skip_steps)
+    except ValueError as e:
+        raise click.UsageError(str(e))
     if (num_train_images is None) != (num_eval_images is None):
         # Both flags flip the TFRecord reader into custom-dataset mode
         # (0-indexed labels, no VALID carve-out); mixing modes between train
@@ -427,7 +552,11 @@ def _run(
         # dir (docs/fleet.md) don't clobber each other's manifest either.
         manifest.disable()
 
-    from sav_tpu.data.pipeline import Split, load
+    if not synth_data:
+        # The TF-backed pipeline import is skipped entirely on the
+        # synthetic path: elasticity soak children restart many times,
+        # and TF's import cost would be re-paid on every attempt.
+        from sav_tpu.data.pipeline import Split, load
 
     mesh_axes = None
     if pp > 1 and (tp > 1 or fsdp > 1 or sp > 1):
@@ -486,6 +615,9 @@ def _run(
         pipeline_parallel=pp if pp > 1 else None,
         pipeline_microbatches=pp_microbatches,
         checkpoint_dir=checkpoint_dir,
+        checkpoint_every_steps=checkpoint_every_steps,
+        checkpoint_every_secs=checkpoint_every_secs,
+        debug_nans=debug_nans,
         log_dir=log_dir,
         diagnostics=diagnostics,
         trace_spans=trace_spans,
@@ -524,6 +656,9 @@ def _run(
             "weight_decay": "weight_decay", "label_smoothing": "label_smoothing",
             "clip_grad": "clip_grad_norm", "grad_accum": "grad_accum_steps",
             "checkpoint_dir": "checkpoint_dir", "seed": "seed",
+            "checkpoint_every_steps": "checkpoint_every_steps",
+            "checkpoint_every_secs": "checkpoint_every_secs",
+            "debug_nans": "debug_nans",
             "device_preprocess": "device_preprocess",
             "async_feed": "async_feed", "feed_depth": "feed_depth",
             "compilation_cache_dir": "compilation_cache_dir",
@@ -657,21 +792,34 @@ def _run(
         # resume must win over re-warm-starting from the pretrain.
         state = trainer.warm_start_from(init_from)
 
+    # Rewind-and-skip shifts the schedule: once position p was dropped,
+    # step s >= p consumed a LATER original batch — so a restart that
+    # resumes past a skip must rebuild its position-keyed stream from
+    # the SHIFTED position, with only the not-yet-reached skips armed
+    # (docs/elasticity.md; the supervisor passes the cumulative set on
+    # every attempt for exactly this reason).
+    from sav_tpu.train.supervisor import resume_schedule_position
+
+    start_pos = resume_schedule_position(start_step, skip)
+    skip = {p for p in skip if p > start_pos}
+
     per_host_batch = batch_size // jax.process_count()
 
-    def eval_iter_fn():
-        return load(
-            Split.TEST,
-            data_dir=data_dir,
-            is_training=False,
-            batch_dims=[per_host_batch],
-            image_size=image_size,
-            transpose=config.transpose_images,
-            bfloat16=dtype == "bfloat16",
-            device_preprocess=config.device_preprocess,
-            fake_data=fake_data,
-            split_examples=num_eval_images,
-        )
+    eval_iter_fn = None
+    if not synth_data:
+        def eval_iter_fn():
+            return load(
+                Split.TEST,
+                data_dir=data_dir,
+                is_training=False,
+                batch_dims=[per_host_batch],
+                image_size=image_size,
+                transpose=config.transpose_images,
+                bfloat16=dtype == "bfloat16",
+                device_preprocess=config.device_preprocess,
+                fake_data=fake_data,
+                split_examples=num_eval_images,
+            )
 
     if eval_only:
         if start_step == 0 and not init_from:
@@ -696,7 +844,20 @@ def _run(
             metrics={k: float(v) for k, v in metrics.items()},
         )
         return
-    if fake_data:
+    if synth_data:
+        from sav_tpu.data.synthetic import synth_resumable_iterator
+
+        # Counter-based batches: each is a pure function of (seed, step),
+        # so starting at the restored step IS the uninterrupted schedule
+        # — step-exact resume with no position bookkeeping to persist.
+        train_iter = synth_resumable_iterator(
+            seed=seed,
+            start_step=start_pos,
+            batch_size=per_host_batch,
+            image_size=image_size,
+            num_classes=config.num_classes,
+        )
+    elif fake_data:
         train_iter = load(
             Split.TRAIN,
             data_dir=data_dir,
@@ -715,7 +876,7 @@ def _run(
 
         train_iter = resumable_train_iterator(
             Split.TRAIN,
-            start_step=start_step,
+            start_step=start_pos,
             seed=seed,
             data_dir=data_dir,
             batch_dims=[per_host_batch],
@@ -728,6 +889,65 @@ def _run(
             crop_area_range=(crop_min_area, 1.0),
             random_flip=train_flip,
         )
+
+    # ---- elasticity layer (docs/elasticity.md) -------------------------
+    # Wrapper order matters: chaos injection (env-gated, test-only) sits
+    # closest to the source so rewind-and-skip can drop a poisoned batch;
+    # the resume probe is outermost so the fingerprint it notes is the
+    # batch actually trained next.
+    from sav_tpu.train.supervisor import chaos_wrap, skip_step_batches
+
+    train_iter = chaos_wrap(train_iter, start_step=start_pos)
+    if skip:
+        from sav_tpu.obs.recorder import batch_fingerprint
+
+        skipped_hashes: dict = {}
+
+        def _on_skip(pos, batch):
+            skipped_hashes[str(pos)] = batch_fingerprint(batch)["hash"]
+            manifest.note("rewind_skip", {
+                "steps": sorted(int(k) for k in skipped_hashes),
+                "hashes": dict(skipped_hashes),
+            })
+            click.echo(
+                f"rewind-and-skip: dropped the batch at schedule step "
+                f"{pos} ({skipped_hashes[str(pos)][:12]}…)",
+                err=True,
+            )
+
+        train_iter = skip_step_batches(
+            train_iter, skip, start_step=start_pos, on_skip=_on_skip
+        )
+    attempt_env = os.environ.get("SAV_SUPERVISED_ATTEMPT")
+    if attempt_env:
+        manifest.note("supervisor", {"attempt": int(attempt_env)})
+    # Resume provenance: fingerprint the first batch this run trains on
+    # (the recorder's blake2b machinery) so supervisors and soak
+    # verifiers can prove resume was step-exact against an uninterrupted
+    # schedule. Written unconditionally — a restart whose checkpoint
+    # never committed resumes from 0, and that fresh start must be as
+    # auditable as a mid-epoch one. One hash per run, not per step.
+    from sav_tpu.obs.recorder import batch_fingerprint
+
+    def _resume_probe(it, from_step):
+        first = True
+        for batch in it:
+            if first:
+                first = False
+                manifest.note("resume", {
+                    "from_step": from_step,
+                    # Original-schedule position the stream restarted
+                    # at (== from_step unless rewind-and-skip shifted
+                    # the schedule).
+                    "schedule_position": start_pos,
+                    "skip_steps": sorted(skip),
+                    "next_batch_hash": batch_fingerprint(batch)["hash"],
+                    "rng": "fold_in(PRNGKey(seed), 1), then "
+                           "fold_in(rng, state.step) per step",
+                })
+            yield batch
+
+    train_iter = _resume_probe(train_iter, start_step)
 
     writer = None
     if jax.process_index() == 0:

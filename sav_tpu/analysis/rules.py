@@ -1133,6 +1133,53 @@ class BareExitInLibrary(Rule):
                 )
 
 
+# ---------------------------------------------------------------- SAV115
+
+
+class ServeHotLoopSync(Rule):
+    """Host sync in the serving batcher's admission/drain path.
+
+    The serving engine's steady-state contract (sav_tpu/serve/,
+    docs/serving.md) mirrors the training hot loop's: request admission
+    (``submit``/``submit_raw``), batch forming (``next_batch`` and the
+    engine's ``_formed_batches`` drain iterator) and placement
+    (``_place_formed``, which runs on the feeder thread so the
+    device_put of batch N+1 overlaps batch N's execution) are host-only
+    bookkeeping. The ONE device sync per shipped batch is the device
+    loop's post-execution result fetch. A ``device_get`` /
+    ``block_until_ready`` / ``.item()`` slipped into the drain — e.g. a
+    per-request result read inside ``next_batch`` — would serialize
+    every request behind a pipeline drain and void both the overlap and
+    the p99 budget. These functions sit outside SAV101's fit/evaluate
+    scope (and outside SAV111/SAV112's sets), so SAV115 owns them.
+    """
+
+    id = "SAV115"
+    name = "serve-hot-loop-sync"
+    severity = "error"
+    hint = (
+        "keep admission/drain/placement host-only; results sync ONCE per "
+        "shipped batch in the device loop — if a sync here is truly "
+        "intentional, pragma it with a justification"
+    )
+
+    # The serving hot path's surface. Disjoint from SAV101's
+    # HOT_FUNCTIONS and SAV111/SAV112's sets (overlap would double-report).
+    SERVE_FUNCTIONS = frozenset(
+        {"submit", "submit_raw", "next_batch", "_formed_batches",
+         "_place_formed"}
+    )
+
+    def check(self, module):
+        for fn in module.functions:
+            if fn.name in self.SERVE_FUNCTIONS:
+                yield from _metrics_sync_findings(
+                    self, module, fn,
+                    where="serve hot path",
+                    coda="the batcher drain must not sync",
+                )
+
+
 # ----------------------------------------------------------- SAV100 (meta)
 
 
@@ -1198,6 +1245,7 @@ ALL_RULES = [
     FleetHotPathSync(),
     ProfilerInHotPath(),
     BareExitInLibrary(),
+    ServeHotLoopSync(),
 ]
 
 

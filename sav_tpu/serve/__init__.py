@@ -1,0 +1,41 @@
+"""Serving package: AOT-compiled, dynamically batched inference.
+
+Re-exports are lazy (PEP 562 via :mod:`sav_tpu._lazy`, like the other
+subpackages): :mod:`sav_tpu.serve.bucketing`, ``batcher`` and
+``latency`` are stdlib-only — the batching policy and its tests run
+without jax — while :mod:`sav_tpu.serve.engine` pulls in the model zoo
+and a backend on first use. docs/serving.md is the subsystem guide.
+"""
+
+from __future__ import annotations
+
+from sav_tpu._lazy import install_lazy_exports
+
+_EXPORTS = {
+    "BucketLadder": "sav_tpu.serve.bucketing",
+    "default_ladder": "sav_tpu.serve.bucketing",
+    "padding_waste": "sav_tpu.serve.bucketing",
+    "DeadlineInfeasibleError": "sav_tpu.serve.batcher",
+    "DynamicBatcher": "sav_tpu.serve.batcher",
+    "FormedBatch": "sav_tpu.serve.batcher",
+    "QueueFullError": "sav_tpu.serve.batcher",
+    "ServeClosedError": "sav_tpu.serve.batcher",
+    "ServeFuture": "sav_tpu.serve.batcher",
+    "ServeRequest": "sav_tpu.serve.batcher",
+    "LatencyLedger": "sav_tpu.serve.latency",
+    "percentile": "sav_tpu.serve.latency",
+    "ServeConfig": "sav_tpu.serve.engine",
+    "ServeEngine": "sav_tpu.serve.engine",
+    "build_infer_fn": "sav_tpu.serve.engine",
+    "preprocess_request": "sav_tpu.serve.preprocess",
+    "resize_bicubic_u8": "sav_tpu.serve.preprocess",
+    "center_crop_window": "sav_tpu.serve.preprocess",
+}
+
+__all__ = list(_EXPORTS)
+
+__getattr__, __dir__ = install_lazy_exports(
+    globals(),
+    _EXPORTS,
+    {"batcher", "bucketing", "engine", "latency", "preprocess"},
+)

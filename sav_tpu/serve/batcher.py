@@ -1,0 +1,348 @@
+"""Deadline-aware dynamic batcher: bounded queue -> bucketed batches.
+
+The serving engine's admission + batch-forming layer. Requests enter a
+bounded FIFO (admission control: a full queue rejects loudly instead of
+growing an unbounded latency tail, and a request whose deadline the
+projected queue wait already blows is SHED at submit —
+:class:`DeadlineInfeasibleError` — rather than served as a guaranteed
+miss); a drain loop groups them into the **largest ladder bucket that
+fills before the earliest admitted deadline's slack expires**:
+
+- Hot queue: the drain grabs everything already waiting, up to the top
+  bucket — full batches, zero added latency, maximum throughput.
+- Trickle traffic: the drain *waits* for more requests, but only while
+  the earliest deadline in the forming batch still leaves room for the
+  batch's own device step — at ``deadline - est_step(bucket)`` it ships
+  whatever it has, padded up to the current bucket.
+
+The deadline guarantee this policy pins (tests/test_serve.py): a batch
+is dispatched no later than ``earliest_deadline - est_step(bucket)``, so
+a request finishes past its deadline by at most the *actual* device step
+time of its bucket — one bucket step, never an unbounded queue wait.
+``est_step`` comes from the engine's measured per-bucket warmup times
+(EMA-updated as traffic flows), so the estimate tracks the hardware.
+
+Results travel on :class:`ServeFuture` — a minimal set-once future the
+engine completes from its device loop (one result set per request; the
+completion path, not this module, owns the single per-batch device
+sync). Stdlib-only, injectable clock; the jax half lives in
+:mod:`sav_tpu.serve.engine`.
+
+savlint SAV115 owns this module's hot functions (``submit`` /
+``next_batch``): a ``device_get`` or implicit ``float(device_scalar)``
+in the admission/drain path would serialize every request behind a
+pipeline drain — the serving twin of SAV101's training-loop contract.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import queue
+import threading
+import time
+from typing import Any, Callable, Optional
+
+from sav_tpu.serve.bucketing import BucketLadder
+
+
+class QueueFullError(RuntimeError):
+    """Admission rejected: the bounded request queue is at capacity."""
+
+
+class DeadlineInfeasibleError(QueueFullError):
+    """Admission rejected: the projected queue+dispatch wait already
+    exceeds the request's deadline — serving it would burn a device
+    step on a guaranteed miss. Subclasses :class:`QueueFullError` so
+    load-shedding callers handle both reject shapes in one place."""
+
+
+class ServeClosedError(RuntimeError):
+    """The engine was stopped with this request still pending."""
+
+
+class ServeFuture:
+    """Set-once result slot the submitter blocks on.
+
+    ``result(timeout)`` returns the engine's per-request output (host
+    numpy row) or re-raises the engine-side failure; a timeout raises
+    ``TimeoutError`` without consuming the slot.
+    """
+
+    def __init__(self):
+        self._done = threading.Event()
+        self._value: Any = None
+        self._error: Optional[BaseException] = None
+
+    def set_result(self, value: Any) -> None:
+        self._value = value
+        self._done.set()
+
+    def set_exception(self, error: BaseException) -> None:
+        self._error = error
+        self._done.set()
+
+    def done(self) -> bool:
+        return self._done.is_set()
+
+    def result(self, timeout: Optional[float] = None) -> Any:
+        if not self._done.wait(timeout):
+            raise TimeoutError("serve request still pending")
+        if self._error is not None:
+            raise self._error
+        return self._value
+
+
+@dataclasses.dataclass
+class ServeRequest:
+    payload: Any  # preprocessed host input (uint8 [H, W, 3] row)
+    deadline_s: float  # latency budget from submit time
+    enqueue_t: float
+    future: ServeFuture
+
+    @property
+    def deadline_t(self) -> float:
+        return self.enqueue_t + self.deadline_s
+
+
+@dataclasses.dataclass
+class FormedBatch:
+    """One drained batch: the real requests (<= bucket), the bucket they
+    pad to, and drain-time telemetry for the latency ledger."""
+
+    requests: list
+    bucket: int
+    queue_depth: int
+    formed_t: float
+
+
+class DynamicBatcher:
+    """Bounded request queue + deadline-aware bucket drain.
+
+    Args:
+      ladder: the engine's compiled bucket ladder.
+      step_time_fn: bucket -> estimated device seconds for one batch of
+        that bucket (the engine's measured warmup/EMA estimate). The
+        drain subtracts it from the earliest deadline to find the
+        latest safe dispatch time.
+      max_queue: admission bound; ``submit`` past it raises
+        :class:`QueueFullError`.
+      default_deadline_s: budget for requests submitted without one.
+      clock: injectable monotonic clock (deterministic tests).
+    """
+
+    _POLL_S = 0.05  # close()-responsiveness bound for blocking waits
+
+    def __init__(
+        self,
+        ladder: BucketLadder,
+        *,
+        step_time_fn: Callable[[int], float],
+        max_queue: int = 256,
+        default_deadline_s: float = 1.0,
+        clock: Callable[[], float] = time.monotonic,
+    ):
+        if max_queue < 1:
+            raise ValueError(f"max_queue must be >= 1, got {max_queue}")
+        if default_deadline_s <= 0:
+            raise ValueError(
+                f"default_deadline_s must be > 0, got {default_deadline_s}"
+            )
+        self.ladder = ladder
+        self._step_time_fn = step_time_fn
+        self._default_deadline_s = default_deadline_s
+        self._clock = clock
+        self._queue: queue.Queue = queue.Queue(maxsize=max_queue)
+        self._closed = threading.Event()
+        # Counters: submit-side writes guarded by _lock (multi-writer);
+        # the drain thread only reads them for telemetry.
+        self._lock = threading.Lock()
+        self._submitted = 0
+        self._rejected = 0
+        self._shed_infeasible = 0
+        # Batches drained but not yet completed (the engine calls
+        # mark_completed once results are distributed): the admission
+        # projection counts them as wait ahead of a new arrival.
+        self._inflight = 0
+
+    # ---------------------------------------------------------- admission
+
+    def submit(
+        self, payload: Any, *, deadline_s: Optional[float] = None
+    ) -> ServeFuture:
+        """Admit one request; returns the future its result arrives on.
+
+        Raises :class:`QueueFullError` when the bounded queue is at
+        capacity (the caller sheds load — an unbounded queue would turn
+        overload into an unbounded latency tail for *every* request) and
+        :class:`ServeClosedError` after ``close()``.
+        """
+        if self._closed.is_set():
+            raise ServeClosedError("batcher is closed")
+        future = ServeFuture()
+        now = self._clock()
+        request = ServeRequest(
+            payload=payload,
+            deadline_s=(
+                deadline_s if deadline_s is not None
+                else self._default_deadline_s
+            ),
+            enqueue_t=now,
+            future=future,
+        )
+        if request.deadline_s <= 0:
+            raise ValueError(
+                f"deadline_s must be > 0, got {request.deadline_s}"
+            )
+        # Deadline-infeasibility shed: project the dispatch wait — the
+        # batches already drained-but-not-completed plus the full
+        # batches queued ahead of this request, each one top-bucket step
+        # (conservative on bucket size, optimistic that the executing
+        # batch is nearly done — the two roughly cancel). If even the
+        # DISPATCH would land past the deadline, the request is a
+        # guaranteed miss and admitting it would burn a device step on
+        # dead work while delaying every request behind it. Rejecting
+        # here is what keeps the served population's overrun bounded by
+        # one bucket step under overload, not just under light load.
+        max_batch = self.ladder.max_batch
+        est = max(float(self._step_time_fn(max_batch)), 0.0)
+        if est > 0.0:
+            with self._lock:
+                inflight = self._inflight
+            batches_ahead = inflight + (
+                (self._queue.qsize() + max_batch) // max_batch
+            )
+            if batches_ahead * est > request.deadline_s:
+                with self._lock:
+                    self._rejected += 1
+                    self._shed_infeasible += 1
+                raise DeadlineInfeasibleError(
+                    f"projected dispatch wait {batches_ahead * est:.3f}s "
+                    f"({batches_ahead} batches ahead at ~{est:.3f}s) "
+                    f"exceeds the {request.deadline_s:.3f}s deadline; "
+                    "shedding instead of serving a guaranteed miss"
+                )
+        try:
+            self._queue.put_nowait(request)
+        except queue.Full:
+            with self._lock:
+                self._rejected += 1
+            raise QueueFullError(
+                f"request queue at capacity ({self._queue.maxsize}); "
+                "shed load or raise max_queue"
+            ) from None
+        if self._closed.is_set():
+            # close() can finish its fail-the-queue pass between this
+            # thread's entry check and the put above; the request would
+            # then sit in a queue nothing will ever drain, stranding
+            # result() forever. Re-running the fail pass covers it (any
+            # request still queued after close must fail anyway).
+            self._fail_queued()
+            raise ServeClosedError("batcher closed during submit")
+        with self._lock:
+            self._submitted += 1
+        return future
+
+    # -------------------------------------------------------------- drain
+
+    def _get(self, timeout: float):
+        """One bounded queue read; None on timeout."""
+        try:
+            return self._queue.get(timeout=timeout)
+        except queue.Empty:
+            return None
+
+    def next_batch(self) -> Optional[FormedBatch]:
+        """Block until a batch is ready under the deadline policy; None
+        once closed and fully drained (the engine's device loop exits).
+
+        Called from exactly one drain thread (the engine's feeder
+        iterator); concurrent drains would interleave FIFO order.
+        """
+        # Wait for the first request, staying responsive to close().
+        first = None
+        while first is None:
+            if self._closed.is_set() and self._queue.empty():
+                return None
+            first = self._get(self._POLL_S)
+        batch = [first]
+        earliest_deadline = first.deadline_t
+        max_batch = self.ladder.max_batch
+        while True:
+            # Grab everything already waiting — the hot-queue fast path.
+            while len(batch) < max_batch:
+                try:
+                    request = self._queue.get_nowait()
+                except queue.Empty:
+                    break
+                batch.append(request)
+                earliest_deadline = min(earliest_deadline, request.deadline_t)
+            if len(batch) >= max_batch:
+                break
+            # Latest safe dispatch: the earliest admitted deadline minus
+            # the current bucket's estimated step. Waiting for a larger
+            # bucket only ever *shrinks* this bound (step_time_fn is
+            # nondecreasing in bucket), so the guarantee survives growth.
+            bucket = self.ladder.bucket_for(len(batch))
+            dispatch_by = earliest_deadline - max(
+                float(self._step_time_fn(bucket)), 0.0
+            )
+            now = self._clock()
+            if now >= dispatch_by or self._closed.is_set():
+                break
+            request = self._get(min(dispatch_by - now, self._POLL_S))
+            if request is not None:
+                batch.append(request)
+                earliest_deadline = min(earliest_deadline, request.deadline_t)
+        with self._lock:
+            self._inflight += 1
+        return FormedBatch(
+            requests=batch,
+            bucket=self.ladder.bucket_for(len(batch)),
+            queue_depth=self._queue.qsize(),
+            formed_t=self._clock(),
+        )
+
+    def mark_completed(self) -> None:
+        """One drained batch finished (results distributed OR failed) —
+        the engine's completion/error paths call this so the admission
+        projection stops counting it as wait ahead of new arrivals."""
+        with self._lock:
+            self._inflight = max(self._inflight - 1, 0)
+
+    # ----------------------------------------------------------- shutdown
+
+    def close(self) -> None:
+        """Stop admission and fail queued-but-unshipped requests.
+
+        Requests already drained into a batch complete normally (the
+        device loop owns them); everything still queued gets
+        :class:`ServeClosedError` on its future. Idempotent.
+        """
+        self._closed.set()
+        self._fail_queued()
+
+    def _fail_queued(self) -> None:
+        """Fail every queued request's future (close()'s pass; submit()
+        re-runs it when its enqueue raced close)."""
+        while True:
+            try:
+                request = self._queue.get_nowait()
+            except queue.Empty:
+                break
+            request.future.set_exception(
+                ServeClosedError("engine stopped before this request shipped")
+            )
+
+    @property
+    def closed(self) -> bool:
+        return self._closed.is_set()
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {
+                "submitted": self._submitted,
+                "rejected": self._rejected,
+                "shed_infeasible": self._shed_infeasible,
+                "inflight": self._inflight,
+                "queued": self._queue.qsize(),
+            }

@@ -1,0 +1,83 @@
+"""Batch-size bucket ladder for the AOT-compiled serving engine.
+
+Serving traffic arrives one request at a time, but the device wants big
+static shapes: XLA compiles one executable per input shape, and a fresh
+shape at request time would pay a full compile mid-traffic. The ladder is
+the contract between the two worlds — a small fixed set of batch sizes
+(default: powers of two), one AOT-compiled executable each, every dynamic
+batch padded up to the smallest bucket that holds it. Padding is wasted
+compute; the ladder's geometry bounds it (a power-of-two ladder wastes
+<50% worst-case, and the latency ledger reports the *measured* waste so
+the bound is checked, not assumed — docs/serving.md).
+
+Stdlib-only: the batcher and its tests drive this without jax.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+
+def default_ladder(max_batch: int) -> list:
+    """Powers of two up to and including ``max_batch``.
+
+    ``max_batch`` itself is always a rung (even when not a power of two)
+    so configured capacity is reachable.
+    """
+    if max_batch < 1:
+        raise ValueError(f"max_batch must be >= 1, got {max_batch}")
+    rungs = []
+    b = 1
+    while b < max_batch:
+        rungs.append(b)
+        b *= 2
+    rungs.append(max_batch)
+    return rungs
+
+
+class BucketLadder:
+    """Sorted, validated batch-size rungs with the two lookups serving
+    needs: the smallest bucket holding ``n`` requests (for padding) and
+    the largest bucket a hot queue can fill outright (for draining)."""
+
+    def __init__(self, buckets: Sequence[int]):
+        rungs = sorted(set(int(b) for b in buckets))
+        if not rungs:
+            raise ValueError("bucket ladder must have at least one rung")
+        if rungs[0] < 1:
+            raise ValueError(f"bucket sizes must be >= 1, got {rungs[0]}")
+        self.buckets = tuple(rungs)
+
+    @property
+    def max_batch(self) -> int:
+        return self.buckets[-1]
+
+    def bucket_for(self, n: int) -> int:
+        """Smallest bucket >= ``n`` (the padding target for a batch of
+        ``n`` real requests). ``n`` above the top rung is a caller bug —
+        the batcher never forms more than ``max_batch``."""
+        if n < 1:
+            raise ValueError(f"need at least one request, got {n}")
+        for b in self.buckets:
+            if b >= n:
+                return b
+        raise ValueError(
+            f"batch of {n} exceeds the top bucket {self.max_batch}"
+        )
+
+    def largest_fillable(self, n: int) -> int:
+        """Largest bucket <= ``n`` — what a queue holding ``n`` requests
+        can fill without padding; the smallest rung when even that does
+        not fill."""
+        filled = self.buckets[0]
+        for b in self.buckets:
+            if b <= n:
+                filled = b
+        return filled
+
+
+def padding_waste(n_real: int, bucket: int) -> float:
+    """Fraction of the bucket's rows that are padding."""
+    if bucket < n_real:
+        raise ValueError(f"bucket {bucket} smaller than batch {n_real}")
+    return (bucket - n_real) / bucket

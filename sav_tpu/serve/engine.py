@@ -1,0 +1,639 @@
+"""AOT-compiled serving engine: bucketed dynamic batching over warm
+executables.
+
+The inference product the training stack feeds (ROADMAP item 2). One
+engine owns:
+
+- **A bucket ladder of AOT executables.** Startup lowers + compiles one
+  inference executable per (model, bucket batch size) — request time
+  never traces or compiles. With ``compilation_cache_dir`` set the
+  compiles round-trip the persistent XLA cache
+  (:mod:`sav_tpu.utils.compile_cache`): a restart re-reads them from
+  disk in milliseconds, and :attr:`startup_report` counts cache hits vs
+  from-scratch compiles so the warm path is assertable, not assumed.
+- **A deadline-aware dynamic batcher** (:mod:`sav_tpu.serve.batcher`):
+  bounded admission, batches formed into the largest bucket that fills
+  before the earliest admitted deadline's slack expires, short batches
+  padded to the bucket with a validity mask.
+- **Host->device overlap**: batch N+1 is padded and placed on device by
+  a :class:`~sav_tpu.data.feeder.DeviceFeeder` worker while the device
+  executes batch N — the training input path's double-buffering rebased
+  onto serving (place of N+1 strictly overlaps execution of N;
+  tests/test_serve.py pins the ordering the same way
+  tests/test_feeder.py does).
+- **A latency ledger + run manifest**: p50/p95/p99 latency, throughput,
+  queue depth, bucket occupancy, and padding waste finalize into a
+  :class:`~sav_tpu.obs.manifest.RunManifest` so
+  ``tools/regression_sentinel.py`` gates serving perf exactly like
+  training perf (docs/serving.md).
+
+Params restore **params-only** from any training checkpoint
+(:meth:`sav_tpu.train.checkpoint.Checkpointer.restore_params_only` —
+opt_state is never read, so serving HBM never holds optimizer moments),
+and the model builds under the same tuned attention dispatch as
+training (``attention_tune_cache`` winners apply at serving shapes too).
+
+The wire format is uint8 end to end: requests carry
+``[image_size, image_size, 3]`` uint8 rows
+(:func:`sav_tpu.serve.preprocess.preprocess_request` shapes raw decoded
+images), and the compiled program normalizes on device with the same op
+the training ``device_preprocess`` path uses.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import threading
+import time
+from typing import Any, Callable, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from sav_tpu.serve.batcher import (
+    DynamicBatcher,
+    FormedBatch,
+    QueueFullError,
+    ServeClosedError,
+)
+from sav_tpu.serve.bucketing import BucketLadder, default_ladder
+from sav_tpu.serve.latency import LatencyLedger
+
+
+@dataclasses.dataclass
+class ServeConfig:
+    """Serving configuration (the inference twin of TrainConfig)."""
+
+    model_name: str = "deit_s_patch16"
+    num_classes: int = 1000
+    image_size: int = 224
+    compute_dtype: str = "bfloat16"
+    # None = the measured three-way auto dispatch (sav_tpu/ops/attention.py);
+    # the attn_tune cache's winners apply at serving shapes too.
+    attention_backend: Optional[str] = None
+    attention_tune_cache: Optional[str] = None
+    model_overrides: Optional[dict] = None
+    # Batch-size rungs, one AOT executable each. None = powers of two up
+    # to max_batch (sav_tpu/serve/bucketing.py).
+    buckets: Optional[list] = None
+    max_batch: int = 8
+    # Admission bound: submits past this many queued requests are
+    # rejected (QueueFullError) instead of growing the latency tail.
+    max_queue: int = 256
+    # Default per-request latency budget; the batcher ships a batch no
+    # later than deadline - est_step(bucket) (docs/serving.md).
+    deadline_ms: float = 100.0
+    # Placed batches buffered beyond the one executing (DeviceFeeder
+    # depth — host->device transfer of batch N+1 overlaps execution of N).
+    feed_depth: int = 2
+    # Training checkpoint to serve (params-only restore; opt_state is
+    # never materialized). None = fresh init (benches, smoke tests).
+    checkpoint_dir: Optional[str] = None
+    # Persistent XLA compile cache: a warm second start compiles nothing
+    # from scratch (startup_report["compiled_from_scratch"] == 0).
+    compilation_cache_dir: Optional[str] = None
+    # Sink for the serving run manifest (None disables).
+    log_dir: Optional[str] = None
+    seed: int = 0
+
+    def to_json(self) -> str:
+        return json.dumps(dataclasses.asdict(self), indent=2)
+
+    @classmethod
+    def from_json(cls, text: str) -> "ServeConfig":
+        return cls(**json.loads(text))
+
+    def ladder(self) -> BucketLadder:
+        return BucketLadder(
+            self.buckets if self.buckets else default_ladder(self.max_batch)
+        )
+
+
+def build_infer_fn(model, compute_dtype) -> Callable:
+    """The serving step: uint8 batch -> masked f32 logits.
+
+    Shared by :class:`ServeEngine` and the zoo ``--serve`` check
+    (tools/zoo_tpu_check.py) so "servable" means exactly one program
+    shape. Normalization runs on device
+    (:func:`sav_tpu.ops.preprocess.normalize_images` — the same op the
+    training ``device_preprocess`` path uses, so serve and train see
+    identical numerics from the same uint8 wire bytes); padded rows are
+    zeroed by the validity mask so the contract "padding never leaks
+    into results" is visible in the program itself.
+    """
+    from sav_tpu.ops import preprocess as pp
+
+    def infer(params, batch_stats, batch):
+        images = batch["images"]
+        if images.dtype != jnp.uint8:
+            raise ValueError(
+                f"serving wire format is uint8, got {images.dtype}; "
+                "preprocess_request() keeps requests uint8 end to end"
+            )
+        x = pp.normalize_images(images, compute_dtype)
+        variables = {"params": params}
+        if batch_stats:
+            variables["batch_stats"] = batch_stats
+        logits = model.apply(variables, x, is_training=False)
+        return logits.astype(jnp.float32) * batch["valid"][:, None]
+
+    return infer
+
+
+def _count_cache_entries(cache_dir: Optional[str]) -> Optional[int]:
+    """Executable entries in the persistent compile cache (None when
+    disabled) — the before/after delta across the AOT loop is the
+    from-scratch compile count. jax writes a ``*-cache`` payload plus a
+    ``*-atime`` access stamp per entry; only the payloads are entries
+    (and the stamps are REWRITTEN on cache hits, so counting them would
+    book a warm start as a recompile)."""
+    if not cache_dir or not os.path.isdir(cache_dir):
+        return 0 if cache_dir else None
+    total = 0
+    for _, _, files in os.walk(cache_dir):
+        total += sum(1 for f in files if not f.endswith("-atime"))
+    return total
+
+
+class ServeEngine:
+    """One model, one bucket ladder of warm executables, one batcher.
+
+    Lifecycle: construction does all the heavy lifting (params restore,
+    per-bucket AOT compile + warmup — :attr:`startup_report`);
+    :meth:`start` opens admission and spins up the serving threads;
+    :meth:`submit` returns a future per request; :meth:`stop` drains
+    in-flight batches, fails still-queued requests, and finalizes the
+    manifest. Context manager = start/stop.
+
+    Test seams: ``place_hook`` fires on the feeder thread after batch
+    placement is issued, ``execute_hook`` on the device loop before
+    execution — the overlap-ordering proof instruments both (the
+    tests/test_feeder.py technique).
+    """
+
+    def __init__(
+        self,
+        config: ServeConfig,
+        *,
+        model=None,
+        params=None,
+        batch_stats=None,
+        mesh=None,
+        manifest=None,
+        place_hook: Optional[Callable[[FormedBatch], None]] = None,
+        execute_hook: Optional[Callable[[FormedBatch], None]] = None,
+    ):
+        self.config = config
+        self.ladder = config.ladder()
+        self.place_hook = place_hook
+        self.execute_hook = execute_hook
+        cache_before = _count_cache_entries(config.compilation_cache_dir)
+        if config.compilation_cache_dir:
+            from sav_tpu.utils.compile_cache import enable_persistent_cache
+
+            # min_compile_time 0: jax's ~1s default floor is tuned for
+            # training (don't litter the cache with trivial programs),
+            # but a serving restart wants EVERY bucket executable back
+            # from disk — a warm start must compile nothing from scratch.
+            enable_persistent_cache(
+                config.compilation_cache_dir, min_compile_time_secs=0.0
+            )
+        if config.attention_tune_cache:
+            from sav_tpu.ops.attn_tuning import set_cache_path
+
+            set_cache_path(config.attention_tune_cache)
+        if mesh is None:
+            # Serving default: one device per engine (replicate engines
+            # for more chips). A multi-device mesh is accepted when every
+            # bucket divides its batch axes (validated below).
+            from sav_tpu.parallel.mesh import create_mesh
+
+            mesh = create_mesh({"data": 1}, devices=jax.devices()[:1])
+        self.mesh = mesh
+        from sav_tpu.parallel.mesh import batch_axes
+
+        baxes = batch_axes(mesh)
+        shards = int(np.prod([mesh.shape[a] for a in baxes])) if baxes else 1
+        bad = [b for b in self.ladder.buckets if b % shards]
+        if bad:
+            raise ValueError(
+                f"buckets {bad} do not divide the mesh batch axes "
+                f"({dict((a, mesh.shape[a]) for a in baxes)}); every "
+                "bucket must shard evenly — adjust the ladder or serve "
+                "on a single-device mesh"
+            )
+        self._batch_sharding = NamedSharding(mesh, P(baxes))
+        self.compute_dtype = (
+            jnp.bfloat16 if config.compute_dtype == "bfloat16" else jnp.float32
+        )
+        t0 = time.perf_counter()
+        if model is None:
+            from sav_tpu.models import create_model
+
+            model = create_model(
+                config.model_name,
+                num_classes=config.num_classes,
+                dtype=self.compute_dtype,
+                backend=config.attention_backend,
+                **(config.model_overrides or {}),
+            )
+        self.model = model
+        self._params, self._batch_stats, params_source = self._load_params(
+            params, batch_stats
+        )
+        self._infer = jax.jit(build_infer_fn(model, self.compute_dtype))
+        # ---- AOT: one executable per bucket, warmed from the cache ----
+        compile_t0 = time.perf_counter()
+        cache_pre_aot = _count_cache_entries(config.compilation_cache_dir)
+        self._executables: dict = {}
+        for bucket in self.ladder.buckets:
+            lowered = self._infer.lower(
+                self._params, self._batch_stats, self._abstract_batch(bucket)
+            )
+            self._executables[bucket] = lowered.compile()
+        compile_s = time.perf_counter() - compile_t0
+        cache_after = _count_cache_entries(config.compilation_cache_dir)
+        # Warmup: one execution per bucket seeds the batcher's per-bucket
+        # step-time estimates (and faults in any lazy backend state).
+        self._step_est: dict = {}
+        warmup_t0 = time.perf_counter()
+        for bucket in self.ladder.buckets:
+            placed = self._place_host_batch(
+                np.zeros(
+                    (bucket, config.image_size, config.image_size, 3),
+                    np.uint8,
+                ),
+                np.ones((bucket,), np.float32),
+            )
+            t = time.perf_counter()
+            jax.block_until_ready(
+                self._executables[bucket](
+                    self._params, self._batch_stats, placed
+                )
+            )
+            self._step_est[bucket] = time.perf_counter() - t
+        scratch = (
+            cache_after - cache_pre_aot
+            if (cache_after is not None and cache_pre_aot is not None)
+            else None
+        )
+        self.startup_report = {
+            "model": config.model_name,
+            "buckets": list(self.ladder.buckets),
+            "params_source": params_source,
+            "startup_s": round(time.perf_counter() - t0, 3),
+            "compile_s": round(compile_s, 3),
+            "warmup_s": round(time.perf_counter() - warmup_t0, 3),
+            "warmup_step_s": {
+                str(b): round(s, 5) for b, s in self._step_est.items()
+            },
+            "cache_entries_before": cache_before,
+            "cache_entries_after": cache_after,
+            # The warm-start proof: from-scratch compiles this startup
+            # (persistent-cache writes during the AOT loop) vs hits.
+            "compiled_from_scratch": scratch,
+            "cache_hits": (
+                len(self.ladder.buckets) - scratch
+                if scratch is not None else None
+            ),
+        }
+        self.ledger = LatencyLedger()
+        self.manifest = manifest
+        if self.manifest is None and config.log_dir:
+            from sav_tpu.obs.manifest import RunManifest
+
+            self.manifest = RunManifest(
+                os.path.join(
+                    config.log_dir,
+                    f"manifest-serve-{time.strftime('%Y%m%d-%H%M%S')}"
+                    f"-{os.getpid()}.json",
+                ),
+                kind="serve",
+                config=dataclasses.asdict(config),
+            )
+            self.manifest.begin()
+        if self.manifest is not None:
+            self.manifest.note("serve_startup", self.startup_report)
+        self._batcher: Optional[DynamicBatcher] = None
+        self._feeder = None
+        self._device_thread: Optional[threading.Thread] = None
+        self._started = False
+        self._stopped = False
+        self._errors = 0
+
+    # ------------------------------------------------------------ startup
+
+    def _load_params(self, params, batch_stats) -> tuple:
+        """(params, batch_stats, source): passed-in, params-only
+        checkpoint restore, or fresh init — replicated over the mesh."""
+        replicated = NamedSharding(self.mesh, P())
+        if params is not None:
+            place = lambda tree: jax.tree.map(  # noqa: E731
+                lambda x: jax.device_put(x, replicated), tree
+            )
+            return place(params), place(batch_stats or {}), "passed"
+        abstract = self._abstract_state(replicated)
+        if self.config.checkpoint_dir:
+            from sav_tpu.train.checkpoint import Checkpointer
+
+            ckpt = Checkpointer(self.config.checkpoint_dir, read_only=True)
+            try:
+                restored = ckpt.restore_params_only(abstract)
+            finally:
+                ckpt.close()
+            if restored is None:
+                raise FileNotFoundError(
+                    "no checkpoint found in "
+                    f"{self.config.checkpoint_dir!r}"
+                )
+            return (
+                restored["params"],
+                restored.get("batch_stats") or {},
+                f"checkpoint:{self.config.checkpoint_dir}",
+            )
+        # Fresh init (benches/smoke): jitted, materialized on the mesh.
+        rng = jax.random.PRNGKey(self.config.seed)
+        s = self.config.image_size
+
+        def init_fn(rng):
+            dummy = jnp.zeros((1, s, s, 3), self.compute_dtype)
+            variables = dict(
+                self.model.init({"params": rng}, dummy, is_training=False)
+            )
+            return {
+                "params": variables.pop("params"),
+                "batch_stats": variables.pop("batch_stats", {}),
+            }
+
+        out_shardings = jax.tree.map(
+            lambda _: replicated, jax.eval_shape(init_fn, rng)
+        )
+        built = jax.jit(init_fn, out_shardings=out_shardings)(rng)
+        return built["params"], built["batch_stats"], "init"
+
+    def _abstract_state(self, sharding) -> dict:
+        """Abstract ``{"params", "batch_stats", "step"}`` template for the
+        params-only restore (shapes from a traced init — no weights are
+        materialized to build it)."""
+        rng = jax.random.PRNGKey(0)
+        s = self.config.image_size
+
+        def init_fn(rng):
+            dummy = jnp.zeros((1, s, s, 3), self.compute_dtype)
+            return dict(self.model.init({"params": rng}, dummy, is_training=False))
+
+        shapes = jax.eval_shape(init_fn, rng)
+        template = {
+            "params": shapes["params"],
+            "batch_stats": shapes.get("batch_stats", {}),
+            "step": jax.ShapeDtypeStruct((), jnp.int32),
+        }
+        return jax.tree.map(
+            lambda sds: jax.ShapeDtypeStruct(
+                sds.shape, sds.dtype, sharding=sharding
+            ),
+            template,
+        )
+
+    def _abstract_batch(self, bucket: int) -> dict:
+        s = self.config.image_size
+        return {
+            "images": jax.ShapeDtypeStruct(
+                (bucket, s, s, 3), jnp.uint8, sharding=self._batch_sharding
+            ),
+            "valid": jax.ShapeDtypeStruct(
+                (bucket,), jnp.float32, sharding=self._batch_sharding
+            ),
+        }
+
+    # ------------------------------------------------------------ serving
+
+    def start(self) -> "ServeEngine":
+        if self._started:
+            raise RuntimeError("engine already started")
+        from sav_tpu.data.feeder import DeviceFeeder
+
+        self._batcher = DynamicBatcher(
+            self.ladder,
+            step_time_fn=self._estimate_step,
+            max_queue=self.config.max_queue,
+            default_deadline_s=self.config.deadline_ms / 1e3,
+        )
+        self._feeder = DeviceFeeder(
+            self._formed_batches(),
+            self._place_formed,
+            depth=self.config.feed_depth,
+            name="serve-feeder",
+        )
+        self._device_thread = threading.Thread(
+            target=self._device_loop, name="serve-device-loop", daemon=True
+        )
+        self._started = True
+        self.ledger.start()
+        self._device_thread.start()
+        return self
+
+    def _estimate_step(self, bucket: int) -> float:
+        """Per-bucket device seconds: warmup-seeded, EMA-updated from
+        real batches (single writer: the device loop)."""
+        return self._step_est.get(bucket, 0.0)
+
+    def _formed_batches(self):
+        """Batcher drain as the feeder's source iterator (runs on the
+        feeder worker thread — the drain wait and the device_put of the
+        next batch both overlap the device loop's execution)."""
+        while True:
+            formed = self._batcher.next_batch()
+            if formed is None:
+                return
+            yield formed
+
+    def _place_host_batch(self, images: np.ndarray, valid: np.ndarray) -> dict:
+        return {
+            "images": jax.device_put(images, self._batch_sharding),
+            "valid": jax.device_put(valid, self._batch_sharding),
+        }
+
+    def _place_formed(self, formed: FormedBatch):
+        """Pad to the bucket + issue the sharded device_put (feeder
+        worker thread — this is the host->device stage that overlaps
+        batch N's execution)."""
+        try:
+            s = self.config.image_size
+            n = len(formed.requests)
+            images = np.zeros((formed.bucket, s, s, 3), np.uint8)
+            for i, request in enumerate(formed.requests):
+                images[i] = request.payload
+            valid = np.zeros((formed.bucket,), np.float32)
+            valid[:n] = 1.0
+            placed = self._place_host_batch(images, valid)
+            if self.place_hook is not None:
+                self.place_hook(formed)
+            return formed, placed
+        except BaseException as e:
+            # A failed placement must not strand its submitters on
+            # never-resolving futures; fail them, then let the feeder
+            # propagate the error to the device loop.
+            self._batcher.mark_completed()
+            for request in formed.requests:
+                if not request.future.done():
+                    request.future.set_exception(e)
+            raise
+
+    def _device_loop(self):
+        """Consume placed batches, execute, distribute results. The ONE
+        device sync per batch (``np.asarray`` on the logits) lives here —
+        after execution, outside the batcher drain (savlint SAV115)."""
+        try:
+            for formed, placed in self._feeder:
+                t0 = time.perf_counter()
+                try:
+                    if self.execute_hook is not None:
+                        self.execute_hook(formed)
+                    out = self._executables[formed.bucket](
+                        self._params, self._batch_stats, placed
+                    )
+                    self._complete(formed, np.asarray(out), t0)
+                except Exception as e:  # noqa: BLE001 — fail batch, serve on
+                    self._errors += 1
+                    self._batcher.mark_completed()
+                    for request in formed.requests:
+                        if not request.future.done():
+                            request.future.set_exception(e)
+        except Exception:  # noqa: BLE001 — feeder/placement died
+            # _place_formed already failed the in-flight batch's futures;
+            # close() fails everything still queued, so no submitter is
+            # left blocked on a future nothing will resolve.
+            self._errors += 1
+            if self._batcher is not None:
+                self._batcher.close()
+
+    def _complete(self, formed: FormedBatch, logits: np.ndarray, t0: float):
+        self._batcher.mark_completed()
+        done_t = time.perf_counter()
+        step_s = done_t - t0
+        # EMA keeps the batcher's dispatch-by estimate tracking the
+        # hardware (warmup seeds it; single writer: this thread).
+        prev = self._step_est.get(formed.bucket, step_s)
+        self._step_est[formed.bucket] = 0.8 * prev + 0.2 * step_s
+        now = time.monotonic()
+        latencies, overruns = [], []
+        for i, request in enumerate(formed.requests):
+            request.future.set_result(logits[i])
+            latencies.append(now - request.enqueue_t)
+            overruns.append(now - request.deadline_t)
+        self.ledger.observe_batch(
+            bucket=formed.bucket,
+            latencies_s=latencies,
+            overruns_s=overruns,
+            queue_depth=formed.queue_depth,
+            step_s=step_s,
+        )
+
+    def submit(self, image: np.ndarray, *, deadline_ms: Optional[float] = None):
+        """Admit one preprocessed uint8 request; returns its future.
+
+        ``image`` must be ``[image_size, image_size, 3]`` uint8 (use
+        :func:`sav_tpu.serve.preprocess.preprocess_request` /
+        :meth:`submit_raw` for raw decoded images). Raises
+        :class:`~sav_tpu.serve.batcher.QueueFullError` on an admission
+        reject (counted on the ledger).
+        """
+        if not self._started or self._stopped:
+            raise ServeClosedError("engine is not serving (start() first)")
+        image = np.asarray(image)  # savlint: disable=SAV115 -- request validation on the submitted HOST image; no device value is in reach here
+        s = self.config.image_size
+        if image.shape != (s, s, 3) or image.dtype != np.uint8:
+            raise ValueError(
+                f"expected a [{s}, {s}, 3] uint8 request, got "
+                f"{image.shape} {image.dtype}; run preprocess_request() "
+                "(or submit_raw) first"
+            )
+        try:
+            return self._batcher.submit(
+                image,
+                deadline_s=(
+                    deadline_ms / 1e3 if deadline_ms is not None else None
+                ),
+            )
+        except QueueFullError:
+            self.ledger.observe_rejected()
+            raise
+
+    def submit_raw(
+        self, image: np.ndarray, *, deadline_ms: Optional[float] = None
+    ):
+        """``submit`` for raw decoded images: center-crop + bicubic
+        resize on the host (uint8 in, uint8 out), then admit."""
+        from sav_tpu.serve.preprocess import preprocess_request
+
+        return self.submit(
+            preprocess_request(image, self.config.image_size),
+            deadline_ms=deadline_ms,
+        )
+
+    # ----------------------------------------------------------- shutdown
+
+    def stop(
+        self,
+        timeout_s: float = 30.0,
+        *,
+        error: Optional[BaseException] = None,
+    ) -> dict:
+        """Drain in-flight batches, fail queued requests, finalize the
+        manifest. Returns the final serving summary. Idempotent.
+
+        ``error`` is the exception the caller is unwinding on (the
+        context manager passes it through): the manifest then finalizes
+        with that exception's outcome, NOT ``ok`` — a run whose driver
+        died mid-serve must never enter the sentinel history as a
+        healthy serving baseline built from the few requests that
+        happened to finish (finalize is first-wins, so a later error
+        finalize by the caller would be a no-op).
+        """
+        if self._stopped:
+            return self.ledger.summary()
+        self._stopped = True
+        if self._batcher is not None:
+            self._batcher.close()
+        if self._device_thread is not None:
+            self._device_thread.join(timeout=timeout_s)
+        if self._feeder is not None:
+            self._feeder.close()
+        summary = self.ledger.summary()
+        if self.manifest is not None:
+            from sav_tpu.obs.manifest import classify_exception
+
+            metrics = self.ledger.flat_metrics()
+            if self.startup_report.get("compiled_from_scratch") is not None:
+                metrics["serve/compiled_from_scratch"] = float(
+                    self.startup_report["compiled_from_scratch"]
+                )
+            self.manifest.note("serve_summary", summary)
+            if error is not None:
+                outcome, detail = classify_exception(error), repr(error)
+            elif self._errors:
+                outcome, detail = "error", f"{self._errors} batch(es) failed"
+            else:
+                outcome, detail = "ok", None
+            self.manifest.finalize(outcome, error=detail, metrics=metrics)
+        return summary
+
+    def __enter__(self) -> "ServeEngine":
+        return self.start() if not self._started else self
+
+    def __exit__(self, exc_type, exc, tb):
+        self.stop(error=exc)
+        return False
+
+    def stats(self) -> dict:
+        out = {"ledger": self.ledger.summary(), "errors": self._errors}
+        if self._batcher is not None:
+            out["batcher"] = self._batcher.stats()
+        if self._feeder is not None:
+            out["feeder"] = self._feeder.stats()
+        return out

@@ -174,6 +174,76 @@ class Checkpointer:
             return restored
         raise first_error
 
+    def restore_params_only(
+        self, template: Any, step: Optional[int] = None
+    ) -> Optional[Any]:
+        """Restore ``params``/``batch_stats``/``step`` WITHOUT reading
+        opt_state — the serving path (docs/serving.md).
+
+        A training checkpoint's optimizer state is 2-3x the parameter
+        bytes (Adam moments, optionally EMA); an inference engine that
+        restored the full TrainState would spend most of its HBM on
+        buffers it immediately drops. This restores through orbax's
+        partial-tree path (``PyTreeRestore(item=subset, transforms={})``)
+        so the opt_state arrays are never read off disk, let alone
+        materialized on device — and because opt_state is skipped
+        entirely, flat-buffer and per-leaf moment layouts (the PR-9
+        auto-detect distinction, :func:`detect_opt_layout`) are both
+        accepted without an optimizer rebuild; the probed layout is only
+        logged for provenance.
+
+        Args:
+          template: ``{"params": ..., "batch_stats": ..., "step": ...}``
+            of concrete arrays or ``jax.ShapeDtypeStruct`` leaves;
+            leaves carrying a ``sharding`` restore directly onto it.
+          step: checkpoint step (default: newest).
+
+        Returns the restored template-structured dict, or None when the
+        directory holds no checkpoint.
+        """
+        if step is None:
+            step = self._mgr.latest_step()
+        if step is None:
+            return None
+        layout = self.opt_layout(step)
+        if layout:
+            logging.info(
+                "params-only restore from step %d (skipping %s opt-state "
+                "layout%s)",
+                step,
+                "flat-buffer" if layout.get("fused") else "per-leaf",
+                " + EMA" if layout.get("ema") else "",
+            )
+        abstract = jax.tree.map(ocp.utils.to_shape_dtype_struct, template)
+        restore_args = jax.tree.map(
+            lambda s: ocp.ArrayRestoreArgs(
+                dtype=s.dtype, sharding=getattr(s, "sharding", None)
+            ),
+            abstract,
+        )
+        # A read-only PyTree-handler manager over the same directory:
+        # StandardSave writes through PyTreeCheckpointHandler, so the
+        # on-disk layout is shared; only PyTreeRestore exposes the
+        # partial-tree ``transforms`` path.
+        try:
+            options = ocp.CheckpointManagerOptions(read_only=True)
+        except TypeError:  # pragma: no cover - older orbax
+            options = ocp.CheckpointManagerOptions(create=False)
+        reader = ocp.CheckpointManager(
+            self._dir,
+            options=options,
+            item_handlers=ocp.PyTreeCheckpointHandler(),
+        )
+        try:
+            return reader.restore(
+                step,
+                args=ocp.args.PyTreeRestore(
+                    item=abstract, transforms={}, restore_args=restore_args
+                ),
+            )
+        finally:
+            reader.close()
+
     def restore_raw(self, step: Optional[int] = None) -> Optional[Any]:
         """Restore a checkpoint in its *saved* structure (no template).
 

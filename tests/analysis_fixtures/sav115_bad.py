@@ -1,0 +1,27 @@
+"""Known-bad fixture for SAV115: device syncs in the serving batcher's
+admission/drain path — a per-request result read inside next_batch(),
+a pipeline drain in submit(), a float() pulling a device metric through
+__float__ in the drain iterator, and a sync in the placement stage."""
+import jax
+
+
+class DynamicBatcher:
+    def submit(self, payload, metrics):
+        payload.block_until_ready()
+        self.last_loss = float(metrics["loss"])
+        self.queue.append(payload)
+
+    def next_batch(self):
+        batch = self.queue.pop()
+        return jax.device_get(batch)
+
+
+class ServeEngine:
+    def _formed_batches(self, metrics):
+        while True:
+            yield float(metrics)
+
+    def _place_formed(self, formed):
+        placed = jax.device_put(formed.images)
+        placed.block_until_ready()
+        return placed

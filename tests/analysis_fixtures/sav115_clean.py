@@ -1,0 +1,38 @@
+"""Known-clean fixture for SAV115: the nearest legitimate idioms — the
+admission path does host bookkeeping only, the drain forms batches from
+host wall clocks, and placement ISSUES the device_put without waiting on
+it (the device loop's post-execution fetch owns the one per-batch sync,
+outside this rule's scope)."""
+import time
+
+import jax
+
+
+class DynamicBatcher:
+    def submit(self, payload, deadline_s):
+        # Host-side admission: wall clocks and queue bookkeeping.
+        record = {"payload": payload, "enqueue_t": time.monotonic(),
+                  "deadline_s": float(deadline_s)}
+        self.queue.append(record)
+        return record
+
+    def next_batch(self):
+        batch = [self.queue.pop()]
+        dispatch_by = batch[0]["enqueue_t"] + batch[0]["deadline_s"]
+        while self.queue and time.monotonic() < dispatch_by:
+            batch.append(self.queue.pop())
+        return batch
+
+
+class ServeEngine:
+    def _formed_batches(self):
+        while True:
+            formed = self.batcher.next_batch()
+            if formed is None:
+                return
+            yield formed
+
+    def _place_formed(self, formed):
+        # Issue the transfer; never wait on it here — the overlap with
+        # batch N's execution is the point.
+        return jax.device_put(formed.images)

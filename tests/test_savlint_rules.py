@@ -111,6 +111,13 @@ BAD_EXPECTATIONS = {
         ("SAV114", 17),  # os._exit from a monitor path
         ("SAV114", 23),  # raise SystemExit as error handling
     ],
+    "sav115_bad.py": [
+        ("SAV115", 10),  # .block_until_ready() in the batcher's submit()
+        ("SAV115", 11),  # float(metrics[...]) in submit()
+        ("SAV115", 16),  # jax.device_get in next_batch() — per-request sync
+        ("SAV115", 22),  # float(metrics) on a bare name in _formed_batches()
+        ("SAV115", 26),  # .block_until_ready() in the placement stage
+    ],
 }
 
 CLEAN_FIXTURES = [
@@ -128,6 +135,7 @@ CLEAN_FIXTURES = [
     "sav112_clean.py",
     "sav113_clean.py",
     "sav_tpu/obs/sav114_clean.py",
+    "sav115_clean.py",
 ]
 
 

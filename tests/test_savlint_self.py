@@ -76,6 +76,23 @@ def test_trainer_hot_loop_suppressions_are_the_known_set():
     assert len(suppressed) == 14
 
 
+def test_serve_hot_loop_suppressions_are_the_known_set():
+    """SAV115's one sanctioned serve-path 'sync' stays exactly the
+    documented site: ``ServeEngine.submit``'s ``np.asarray`` validation
+    of the submitted HOST image (no device value in reach). The batcher
+    itself — the drain the rule exists to keep sync-free — carries
+    zero suppressions."""
+    result = lint_paths([os.path.join(ROOT, "sav_tpu", "serve")], root=ROOT)
+    assert result.findings == []
+    sav115 = [f for f in result.suppressed if f.rule == "SAV115"]
+    assert [os.path.basename(f.path) for f in sav115] == ["engine.py"]
+    batcher = lint_paths(
+        [os.path.join(ROOT, "sav_tpu", "serve", "batcher.py")], root=ROOT
+    )
+    assert batcher.findings == []
+    assert batcher.suppressed == []
+
+
 def test_library_exit_suppressions_are_the_two_contracts():
     """SAV114's sanctioned library exits stay exactly the documented
     pair (docs/elasticity.md exit-code table): the watchdog's os._exit

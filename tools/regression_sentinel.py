@@ -23,7 +23,10 @@ lower), ``attention_core_frac`` (measured attention-core share of
 device time from ``bench.py --trace``, lower — present only on traced
 benches; untraced records are skipped, not zero-filled),
 ``goodput_frac`` (elastic-training goodput from supervisor manifest
-chains, higher — supervised runs only, docs/elasticity.md). Infra failures
+chains, higher — supervised runs only, docs/elasticity.md),
+``p99_latency_ms`` (serving tail latency from ``tools/serve_bench.py``,
+lower) and ``serve_throughput`` (serving req/s, higher — both present
+only on serving records, docs/serving.md). Infra failures
 are *reported but never scored* — a down relay is
 not a regression (the BENCH_r05 lesson), and a history whose only deltas
 are infra failures exits clean.
@@ -82,6 +85,17 @@ METRICS = {
     # on supervised runs; unsupervised records are skipped, not
     # zero-filled. Absolute floor: one point of wall share.
     "goodput_frac": (True, 0.01),
+    # Serving tail latency (tools/serve_bench.py via the LatencyLedger;
+    # docs/serving.md): lower is better — a rise means requests started
+    # missing their budget even if throughput held. Present only on
+    # serving records (serve manifests / serve_bench lines); training
+    # records are skipped, not zero-filled — the attention_core_frac
+    # contract. Absolute floor 1 ms: sub-millisecond jitter on a flat
+    # history is scheduling noise, not a regression.
+    "p99_latency_ms": (False, 1.0),
+    # Serving request throughput (req/s over the serving window). Higher
+    # is better. Same presence contract as p99_latency_ms.
+    "serve_throughput": (True, 0.0),
 }
 
 EXIT_CLEAN, EXIT_REGRESSION, EXIT_USAGE = 0, 1, 2

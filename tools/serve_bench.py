@@ -1,0 +1,271 @@
+#!/usr/bin/env python
+"""Serving benchmark — open-loop load against the AOT serving engine.
+
+The serving twin of ``bench.py``: spins up a :class:`ServeEngine`,
+offers a synthetic open-loop request stream (arrivals on a fixed
+schedule — the load does NOT slow down when the server does, which is
+what makes p99 honest), and prints ONE parseable JSON line with the
+serving headline: p50/p95/p99 latency, throughput, bucket occupancy,
+padding-waste fraction, queue depth, deadline overruns, and the AOT
+startup report (compile seconds + persistent-cache hit counts — the
+warm-restart proof). A :class:`RunManifest` (kind ``serve``) is
+finalized with the same numbers, so ``tools/regression_sentinel.py``
+gates ``p99_latency_ms`` (lower-better) and ``serve_throughput``
+(higher-better) exactly like training throughput (docs/serving.md).
+
+A/B arms:
+  --batch-1          ladder [1] — the no-batching baseline the dynamic
+                     batcher must beat (docs/serving.md's throughput
+                     proof; also pinned in tests/test_serve.py)
+  --rate 0           flood (all requests offered at t=0): measures the
+                     drain ceiling
+  --rate R           Poisson-free fixed schedule at R req/s: measures
+                     latency under a target load
+
+Usage:
+  python tools/serve_bench.py --model vit_ti_patch16 --requests 512
+  python tools/serve_bench.py --checkpoint runs/train/ckpt --rate 200
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO_ROOT not in sys.path:
+    sys.path.insert(0, _REPO_ROOT)
+
+
+def _parse_buckets(text):
+    return [int(b) for b in text.split(",") if b.strip()]
+
+
+def run(args, manifest) -> dict:
+    import numpy as np
+
+    from sav_tpu.serve.batcher import QueueFullError
+    from sav_tpu.serve.engine import ServeConfig, ServeEngine
+
+    buckets = _parse_buckets(args.buckets) if args.buckets else None
+    if args.batch_1:
+        buckets = [1]
+    config = ServeConfig(
+        model_name=args.model,
+        num_classes=args.num_classes,
+        image_size=args.image_size,
+        attention_backend=None if args.backend == "auto" else args.backend,
+        attention_tune_cache=args.attn_tune_cache,
+        model_overrides=(
+            json.loads(args.model_overrides) if args.model_overrides else None
+        ),
+        buckets=buckets,
+        max_batch=args.max_batch,
+        max_queue=args.max_queue,
+        deadline_ms=args.deadline_ms,
+        checkpoint_dir=args.checkpoint,
+        compilation_cache_dir=args.compilation_cache_dir,
+    )
+    engine = ServeEngine(config, manifest=manifest)
+    rng = np.random.default_rng(0)
+    # A small pool of distinct request images (a fresh image per request
+    # would spend the bench generating noise, one shared image would let
+    # a cache cheat): submissions cycle the pool.
+    pool = [
+        rng.integers(
+            0, 256, (args.image_size, args.image_size, 3), dtype=np.uint8
+        )
+        for _ in range(min(args.requests, 16))
+    ]
+    futures = []
+    rejected = 0
+    with engine:
+        t0 = time.monotonic()
+        for i in range(args.requests):
+            if args.rate > 0:
+                # Open loop: arrival i is DUE at i/rate regardless of how
+                # the server is keeping up.
+                due = t0 + i / args.rate
+                delay = due - time.monotonic()
+                if delay > 0:
+                    time.sleep(delay)
+            try:
+                futures.append(engine.submit(pool[i % len(pool)]))
+            except QueueFullError:
+                rejected += 1
+        deadline = time.monotonic() + args.drain_timeout
+        for future in futures:
+            future.result(timeout=max(deadline - time.monotonic(), 0.1))
+    summary = engine.stop()
+    return {
+        "summary": summary,
+        "startup": engine.startup_report,
+        "offered": args.requests,
+        "rejected_at_submit": rejected,
+    }
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter,
+    )
+    parser.add_argument("--model", default="deit_s_patch16")
+    parser.add_argument("--num-classes", type=int, default=1000)
+    parser.add_argument("--image-size", type=int, default=224)
+    parser.add_argument(
+        "--backend", default="auto",
+        choices=["auto", "xla", "fused", "pallas"],
+        help="attention backend (auto = the measured three-way dispatch; "
+        "attn_tune cache winners apply at serving shapes too)",
+    )
+    parser.add_argument("--model-overrides", default=None, metavar="JSON")
+    parser.add_argument(
+        "--buckets", default=None,
+        help="comma-separated batch-size ladder (default: powers of two "
+        "up to --max-batch); one AOT executable per rung",
+    )
+    parser.add_argument("--max-batch", type=int, default=8)
+    parser.add_argument(
+        "--batch-1", action="store_true",
+        help="ladder [1]: the no-batching A/B baseline",
+    )
+    parser.add_argument("--max-queue", type=int, default=256)
+    parser.add_argument("--deadline-ms", type=float, default=100.0)
+    parser.add_argument(
+        "--requests", type=int, default=512,
+        help="total synthetic requests to offer",
+    )
+    parser.add_argument(
+        "--rate", type=float, default=0.0,
+        help="open-loop offered load in req/s (0 = flood everything at "
+        "t=0, measuring the drain ceiling)",
+    )
+    parser.add_argument(
+        "--drain-timeout", type=float, default=120.0,
+        help="seconds to wait for the last future before giving up",
+    )
+    parser.add_argument(
+        "--checkpoint", default=None,
+        help="training checkpoint dir to serve (params-only restore — "
+        "opt_state is never materialized)",
+    )
+    parser.add_argument("--compilation-cache-dir", default=None)
+    parser.add_argument("--attn-tune-cache", default=None)
+    parser.add_argument(
+        "--backend-wait", type=float, default=600.0,
+        help="seconds to poll for the accelerator relay before giving up "
+        "(0 disables)",
+    )
+    parser.add_argument(
+        "--manifest", default=None,
+        help="run-manifest path (default: a per-run "
+        "runs/serve/manifest-serve-<stamp>.json — the sentinel's "
+        "directory expansion globs manifest*.json)",
+    )
+    args = parser.parse_args(argv)
+    if args.manifest is None:
+        args.manifest = os.path.join(
+            "runs", "serve",
+            f"manifest-serve-{time.strftime('%Y%m%d-%H%M%S')}"
+            f"-{os.getpid()}.json",
+        )
+
+    from sav_tpu.obs.manifest import RunManifest, classify_exception
+
+    manifest = RunManifest(args.manifest, kind="serve", argv=sys.argv[1:])
+    manifest.begin()
+    if args.backend_wait > 0 and "pytest" not in sys.modules:
+        from sav_tpu.obs.fleet import write_probe_timeline
+        from sav_tpu.utils.backend_probe import (
+            unreachable_message,
+            wait_for_backend,
+        )
+
+        probe_log: list = []
+        platform = wait_for_backend(
+            args.backend_wait, tag="serve_bench", probe_log=probe_log
+        )
+        if platform is None:
+            message = unreachable_message("serve_bench", args.backend_wait)
+            probe = {
+                "deadline_s": args.backend_wait,
+                "attempts": len(probe_log),
+                "probes": probe_log,
+            }
+            manifest.finalize(
+                "backend_unreachable", error=message, exit_code=3,
+                notes={"backend_probe": probe},
+            )
+            probe_path = write_probe_timeline(
+                os.path.dirname(manifest.path) or ".", probe_log,
+                deadline_s=args.backend_wait, tag="serve_bench",
+            )
+            print(message, file=sys.stderr)
+            print(json.dumps({
+                "metric": f"{args.model} serve",
+                "outcome": "backend_unreachable",
+                "backend_probe": probe,
+                "probe_timeline": probe_path,
+                "manifest": manifest.path,
+            }))
+            return 3
+
+    try:
+        result = run(args, manifest)
+    except BaseException as e:
+        outcome = classify_exception(e)
+        manifest.finalize(outcome, error=repr(e), exit_code=1)
+        print(json.dumps({
+            "outcome": outcome,
+            "error": repr(e)[:500],
+            "manifest": manifest.path,
+        }))
+        raise
+
+    import jax
+
+    summary = result["summary"]
+    latency = summary.get("latency_ms", {})
+    ladder_desc = "bs1" if args.batch_1 else (
+        args.buckets or f"pow2<={args.max_batch}"
+    )
+    load_desc = f"{args.rate} req/s" if args.rate > 0 else "flood"
+    out = {
+        "metric": (
+            f"{args.model} serve p99 ms (buckets {ladder_desc}, "
+            f"{load_desc}, deadline {args.deadline_ms} ms, "
+            f"{args.requests} reqs)"
+        ),
+        "unit": "ms",
+        "outcome": "ok",
+        "platform": jax.devices()[0].platform,
+        "p50_latency_ms": latency.get("p50"),
+        "p95_latency_ms": latency.get("p95"),
+        "p99_latency_ms": latency.get("p99"),
+        "serve_throughput": summary["throughput_rps"],
+        "padding_waste_frac": summary["padding_waste_frac"],
+        "bucket_occupancy": summary["bucket_occupancy"],
+        "queue_depth_avg": summary["queue_depth_avg"],
+        "queue_depth_max": summary["queue_depth_max"],
+        "deadline_overruns": summary["deadline_overruns"],
+        "requests": summary["requests"],
+        "rejected": result["rejected_at_submit"],
+        "startup": result["startup"],
+        "manifest": manifest.path,
+    }
+    # Engine.stop() finalized the manifest with the serve/* metrics
+    # (sav_tpu/obs/manifest.py reads serve/p99_latency_ms and
+    # serve/throughput_rps back out as the sentinel's metric names);
+    # ride the platform + metric description along.
+    manifest.note("metric", out["metric"])
+    manifest.note("platform", out["platform"])
+    print(json.dumps(out))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

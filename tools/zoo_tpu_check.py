@@ -7,7 +7,17 @@ VMEM store passed every CPU test and failed on-chip — see PERF.md §6).
 This sweep drives one small config per family through ``create_model``
 fwd+bwd per available backend and reports compile/run/nonfinite status.
 
-Run: python tools/zoo_tpu_check.py  (~a few minutes; needs the TPU)
+``--serve`` runs the serving arm instead: AOT-lower + compile the
+inference program (:func:`sav_tpu.serve.engine.build_infer_fn` — uint8
+in, device-side normalize, masked logits out; the exact program the
+serving engine buckets) for ONE representative per model family at the
+smallest bucket, proving all seven families are servable. ``--smoke``
+shrinks the configs (reduced depth, 64px inputs) so the serve arm runs
+in tier-1 on CPU (tests/test_serve.py); without it the full-size check
+needs the chip.
+
+Run: python tools/zoo_tpu_check.py            (~a few minutes; TPU)
+     python tools/zoo_tpu_check.py --serve    (serving arm)
 """
 
 from __future__ import annotations
@@ -36,6 +46,61 @@ CASES = [
     ("botnet_t3", {}, 224, ("xla", "pallas")),  # fused rel-pos kernel
     ("mixer_s_patch16", {}, 224, ("xla",)),  # no attention
 ]
+
+
+# The serving arm: one representative per model FAMILY (the acceptance
+# unit for "servable" — vit covers the rope/moe/deit variants' attention
+# plumbing, which the training CASES sweep separately). --smoke swaps in
+# the override dict to shrink depth for the tier-1 CPU run.
+SERVE_CASES = [
+    # (name, smoke_overrides)
+    ("vit_ti_patch16", {"num_layers": 2}),
+    ("botnet_t3", {"stage_sizes": (1, 1, 1, 1)}),
+    ("tnt_s_patch16", {"num_layers": 2}),
+    ("ceit_t", {"num_layers": 2}),
+    ("cait_xxs_24", {"num_layers": 2, "num_layers_token_only": 1}),
+    ("cvt-13", {"num_layers": (1, 1, 1)}),
+    ("mixer_s_patch16", {"num_layers": 2}),
+]
+
+
+def serve_check(name: str, kwargs: dict, image_size: int, batch: int):
+    """AOT-lower + compile + run the serving program for one family at
+    one bucket; returns (loss-free) (finite, compile+run seconds)."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from sav_tpu.models import create_model
+    from sav_tpu.serve.engine import build_infer_fn
+
+    model = create_model(name, num_classes=10, dtype=jnp.bfloat16, **kwargs)
+    rngs = {"params": jax.random.PRNGKey(0)}
+    x0 = jnp.zeros((batch, image_size, image_size, 3), jnp.bfloat16)
+    variables = dict(
+        jax.jit(lambda r, xx: model.init(r, xx, is_training=False))(rngs, x0)
+    )
+    params = variables.pop("params")
+    batch_stats = variables.pop("batch_stats", {})
+    infer = build_infer_fn(model, jnp.bfloat16)
+    abstract = {
+        "images": jax.ShapeDtypeStruct(
+            (batch, image_size, image_size, 3), jnp.uint8
+        ),
+        "valid": jax.ShapeDtypeStruct((batch,), jnp.float32),
+    }
+    t0 = time.perf_counter()
+    exe = jax.jit(infer).lower(params, batch_stats, abstract).compile()
+    host = {
+        "images": np.random.default_rng(0).integers(
+            0, 256, (batch, image_size, image_size, 3), dtype=np.uint8
+        ),
+        "valid": np.ones((batch,), np.float32),
+    }
+    logits = jax.device_get(exe(params, batch_stats, host))
+    dt = time.perf_counter() - t0
+    finite = bool(np.isfinite(logits).all())
+    return finite, dt
 
 
 def check(name: str, kwargs: dict, image_size: int, backend: str, batch: int):
@@ -99,7 +164,39 @@ def main():
     p = argparse.ArgumentParser()
     p.add_argument("--batch", type=int, default=4)
     p.add_argument("--only", default=None, help="substring filter on model name")
+    p.add_argument(
+        "--serve", action="store_true",
+        help="serving arm: AOT-compile the inference program for one "
+        "representative per family at the smallest bucket (batch 1)",
+    )
+    p.add_argument(
+        "--smoke", action="store_true",
+        help="with --serve: shrink configs (2-ish layers, 64px) so the "
+        "sweep runs in tier-1 on CPU",
+    )
     args = p.parse_args()
+
+    if args.serve:
+        image_size = 64 if args.smoke else 224
+        failures = 0
+        for name, smoke_overrides in SERVE_CASES:
+            if args.only and args.only not in name:
+                continue
+            kwargs = smoke_overrides if args.smoke else {}
+            try:
+                finite, dt = serve_check(name, kwargs, image_size, batch=1)
+                status = "OK " if finite else "NONFINITE"
+                print(
+                    f"{status} serve {name:20s} aot-compile+run {dt:.1f}s",
+                    flush=True,
+                )
+                failures += 0 if finite else 1
+            except Exception:
+                failures += 1
+                print(f"FAIL serve {name:20s}", flush=True)
+                traceback.print_exc()
+        print(f"\n{'ALL SERVABLE' if failures == 0 else f'{failures} FAILURES'}")
+        raise SystemExit(1 if failures else 0)
 
     failures = 0
     for name, kwargs, image_size, backends in CASES:

@@ -1180,6 +1180,61 @@ class ServeHotLoopSync(Rule):
                 )
 
 
+# ---------------------------------------------------------------- SAV116
+
+
+class ServeTelemetryHotPathSync(Rule):
+    """Host sync in the serve-telemetry span/window/heartbeat path.
+
+    The serve telemetry layer (sav_tpu/serve/telemetry.py,
+    docs/serving.md) rides INSIDE the paths SAV115 keeps sync-free: span
+    stamps fire in the batcher's admission/drain and the engine's device
+    loop, window observation runs on every completed batch, and the
+    heartbeat thread snapshots windows that those paths feed. The
+    contract mirrors the recorder's (SAV111) and fleet's (SAV112):
+    every value a stamp/window/heartbeat touches is already host-side —
+    monotonic clock reads, the latency floats the device loop computed
+    after its one sanctioned sync. A ``device_get`` /
+    ``block_until_ready`` / ``.item()`` slipped into ``stamp()`` /
+    ``begin_trace()`` / ``observe_window()`` / ``observe_completed()``
+    / ``observe_shed()`` / ``serve_beat()``, or a ``float(metrics...)``
+    pulling a device scalar through ``__float__``, would serialize the
+    batcher drain or the device loop behind a pipeline drain and void
+    the p99 the telemetry exists to report. These functions sit outside
+    SAV101's fit/evaluate scope and outside SAV111/SAV112/SAV115's
+    sets, so SAV116 owns them.
+    """
+
+    id = "SAV116"
+    name = "serve-telemetry-hot-path-sync"
+    severity = "error"
+    hint = (
+        "keep span stamps / window observation / heartbeats host-only "
+        "(the device loop's ONE post-execution fetch already synced "
+        "every value telemetry needs); if a sync here is truly "
+        "intentional, pragma it with a justification"
+    )
+
+    # The serve-telemetry hot surface. Deliberately DISJOINT from
+    # SAV101's HOT_FUNCTIONS, SAV111's recorder set ("observe_batch" —
+    # which also covers LatencyLedger.observe_batch), SAV112's fleet set
+    # ("beat"/"note_window"/"request") and SAV115's serve set (overlap
+    # would double-report the same call).
+    TELEMETRY_FUNCTIONS = frozenset(
+        {"stamp", "begin_trace", "observe_window", "observe_completed",
+         "observe_shed", "serve_beat"}
+    )
+
+    def check(self, module):
+        for fn in module.functions:
+            if fn.name in self.TELEMETRY_FUNCTIONS:
+                yield from _metrics_sync_findings(
+                    self, module, fn,
+                    where="serve telemetry hot path",
+                    coda="span/window/heartbeat telemetry must not sync",
+                )
+
+
 # ----------------------------------------------------------- SAV100 (meta)
 
 
@@ -1246,6 +1301,7 @@ ALL_RULES = [
     ProfilerInHotPath(),
     BareExitInLibrary(),
     ServeHotLoopSync(),
+    ServeTelemetryHotPathSync(),
 ]
 
 

@@ -43,6 +43,11 @@ TRIGGERS = (
     "stall_anomaly",     # the goodput ledger flagged a stalled window
     "step_time_spike",   # per-window step time beyond the robust gate
     "watchdog_soft",     # the hang watchdog crossed its warning stage
+    "serve_p99_spike",   # serving: request latency beyond the robust
+                         # slow-exemplar gate (sav_tpu/serve/telemetry.py;
+                         # "step" counts completed batches)
+    "serve_queue_spike", # serving: queue depth beyond its robust gate
+                         # (overload building faster than the drain)
     "manual",            # explicit request (tools, tests)
 )
 

@@ -246,6 +246,40 @@ class HeartbeatWriter:
         finally:
             self._lock.release()
 
+    def serve_beat(self, payload: dict) -> bool:
+        """Append one ``kind=serve`` heartbeat line (the serving
+        engine's time-cadenced stream, sav_tpu/serve/telemetry.py —
+        serving has no step boundary, so these carry a windowed
+        metrics snapshot instead of a step number). Host-only like
+        ``beat()`` (savlint SAV116 owns the serve-telemetry callers);
+        same bounded-lock discipline — a wedged writer drops the beat,
+        never blocks serving. Returns True iff the line was appended,
+        so callers' beat counters match the lines actually on disk
+        (a dropped or post-close beat must not inflate them)."""
+        t0 = self._perf()
+        record: dict = {
+            "schema": FLEET_SCHEMA,
+            "kind": "serve",
+            "proc": self.process_index,
+            "procs": self.process_count,
+            "t": round(float(self._clock()), 3),
+            "host": self._host,
+            "pid": self._pid,
+        }
+        record.update(payload)
+        if not self._lock.acquire(timeout=self.LOCK_TIMEOUT_S):
+            self._dropped += 1
+            return False
+        try:
+            if self._closed:
+                return False
+            self._append(record)
+            self._beats += 1
+            self._write_s += self._perf() - t0
+            return True
+        finally:
+            self._lock.release()
+
     def fleet_event(self, event: str, **fields) -> None:
         """Append an out-of-band event line (watchdog soft stage, probe
         outcomes). Callable from any thread; host-only like beat()."""
@@ -400,6 +434,78 @@ def read_probe_timeline(log_dir: str) -> list[dict]:
     except OSError:
         pass
     return records
+
+
+def iter_manifests(log_dir: str):
+    """Yield ``(path, doc)`` for every parseable ``manifest*.json``
+    directly under ``log_dir`` (sorted by name; torn/unreadable/non-dict
+    files skipped) — the ONE manifest-discovery loop behind the offline
+    readers (``read_autoprof_captures``, serve telemetry's
+    ``find_serve_manifests``)."""
+    import glob as _glob
+
+    for path in sorted(
+        _glob.glob(os.path.join(log_dir, "manifest*.json"))
+    ):
+        try:
+            with open(path) as f:
+                doc = json.load(f)
+        except (OSError, json.JSONDecodeError):
+            continue
+        if isinstance(doc, dict):
+            yield path, doc
+
+
+def read_autoprof_captures(log_dir: str) -> list:
+    """Anomaly-profiler capture records for a log dir: every manifest's
+    ``notes.autoprof`` (training runs stamp ``manifest.json``, serve
+    runs ``manifest*-serve-*.json``) merged with every process's
+    sidecar (``autoprof/proc*_captures.jsonl`` — non-zero processes run
+    with a disabled manifest, so the straggler's own trace only exists
+    in its sidecar). Deduplicated by trace path. The ONE reader behind
+    ``fleet_status``/``serve_status`` — stdlib-only, laptop-safe."""
+    import glob as _glob
+
+    captures: list = []
+    for _, doc in iter_manifests(log_dir):
+        noted = (doc.get("notes") or {}).get("autoprof")
+        if isinstance(noted, list):
+            captures.extend(c for c in noted if isinstance(c, dict))
+    for sidecar in sorted(
+        _glob.glob(os.path.join(log_dir, "autoprof", "proc*_captures.jsonl"))
+    ):
+        try:
+            with open(sidecar) as f:
+                for line in f:
+                    line = line.strip()
+                    if not line:
+                        continue
+                    try:
+                        captures.append(json.loads(line))
+                    except json.JSONDecodeError:
+                        continue
+        except OSError:
+            continue
+    seen: set = set()
+    unique = []
+    for c in captures:
+        key = c.get("path")
+        if key is not None:
+            if key in seen:
+                continue
+            seen.add(key)
+        unique.append(c)
+    return unique
+
+
+def format_unix(t) -> str:
+    """``HH:MM:SS`` for a unix stamp, ``?`` on anything else — the
+    offline renderers' shared time formatter."""
+    if not isinstance(t, (int, float)):
+        return "?"
+    import datetime
+
+    return datetime.datetime.fromtimestamp(t).strftime("%H:%M:%S")
 
 
 def _median(values: list) -> Optional[float]:

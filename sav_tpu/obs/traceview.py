@@ -505,6 +505,50 @@ def compare(
     }
 
 
+# --------------------------------------------------------- request planes
+
+
+def request_spans(events: list[dict]) -> dict[int, dict]:
+    """Per-request serve span timelines from a chrome trace.
+
+    The serving telemetry layer (``sav_tpu/serve/telemetry.py``) exports
+    its span ring as complete events tagged with a ``request`` arg (one
+    row per request, one event per lifecycle interval). This reads them
+    back — the request-timeline twin of :func:`device_op_times`, so
+    ``tools/trace_report.py`` renders request traces with the machinery
+    that reads device profiles. Returns ``{request_id: {"stages":
+    [(name, start_ms, dur_ms)...], "total_ms", "dominant_stage",
+    "bucket", "deadline_ms", "overrun_ms"}}`` (empty when the trace has
+    no request plane).
+    """
+    out: dict[int, dict] = {}
+    for e in events:
+        args = e.get("args") or {}
+        if e.get("ph") != "X" or "request" not in args:
+            continue
+        rid = args["request"]
+        view = out.setdefault(rid, {
+            "stages": [],
+            "total_ms": 0.0,
+            "bucket": args.get("bucket"),
+            "deadline_ms": args.get("deadline_ms"),
+            "overrun_ms": args.get("overrun_ms"),
+        })
+        dur_ms = float(e.get("dur", 0.0)) / 1e3
+        view["stages"].append(
+            (e.get("name", "?"), float(e.get("ts", 0.0)) / 1e3, dur_ms)
+        )
+        view["total_ms"] += dur_ms
+    for view in out.values():
+        view["stages"].sort(key=lambda s: s[1])
+        view["total_ms"] = round(view["total_ms"], 3)
+        view["dominant_stage"] = (
+            max(view["stages"], key=lambda s: s[2])[0]
+            if view["stages"] else None
+        )
+    return out
+
+
 # --------------------------------------------------------------- summaries
 
 TRACEVIEW_SCHEMA = 1
@@ -518,11 +562,16 @@ def summarize(
     steps: Optional[int] = None,
     tolerance: float = DISAGREEMENT_TOLERANCE,
     top_ops: int = 10,
+    events: Optional[list[dict]] = None,
 ) -> dict:
     """One trace file → the machine-readable summary every consumer
     renders (autoprof sidecars, ``tools/trace_report.py``,
-    ``run_report.py --trace``, bench's JSON line)."""
-    events = load_trace(trace_path)
+    ``run_report.py --trace``, bench's JSON line). Pass ``events`` when
+    the trace is already loaded (a real capture gunzips+parses tens of
+    MB — callers that also need the raw events must not pay it twice).
+    """
+    if events is None:
+        events = load_trace(trace_path)
     totals, counts, selector = device_op_times(events)
     span_ms, busy_ms = span_and_busy_ms(events)
     n_steps = steps if steps is not None else count_steps(events)

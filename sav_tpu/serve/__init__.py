@@ -30,6 +30,17 @@ _EXPORTS = {
     "preprocess_request": "sav_tpu.serve.preprocess",
     "resize_bicubic_u8": "sav_tpu.serve.preprocess",
     "center_crop_window": "sav_tpu.serve.preprocess",
+    # Telemetry (stdlib-only like the batcher: spans, windows, SLO,
+    # serve heartbeats + their offline aggregation — docs/serving.md).
+    "LiveWindow": "sav_tpu.serve.telemetry",
+    "RequestTrace": "sav_tpu.serve.telemetry",
+    "SLOTracker": "sav_tpu.serve.telemetry",
+    "ServeTelemetry": "sav_tpu.serve.telemetry",
+    "SlidingWindow": "sav_tpu.serve.telemetry",
+    "SpanRing": "sav_tpu.serve.telemetry",
+    "aggregate_serve": "sav_tpu.serve.telemetry",
+    "export_chrome_trace": "sav_tpu.serve.telemetry",
+    "stamp": "sav_tpu.serve.telemetry",
 }
 
 __all__ = list(_EXPORTS)
@@ -37,5 +48,6 @@ __all__ = list(_EXPORTS)
 __getattr__, __dir__ = install_lazy_exports(
     globals(),
     _EXPORTS,
-    {"batcher", "bucketing", "engine", "latency", "preprocess"},
+    {"batcher", "bucketing", "engine", "latency", "preprocess",
+     "telemetry"},
 )

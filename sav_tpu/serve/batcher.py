@@ -43,6 +43,7 @@ import time
 from typing import Any, Callable, Optional
 
 from sav_tpu.serve.bucketing import BucketLadder
+from sav_tpu.serve.telemetry import stamp
 
 
 class QueueFullError(RuntimeError):
@@ -98,6 +99,10 @@ class ServeRequest:
     deadline_s: float  # latency budget from submit time
     enqueue_t: float
     future: ServeFuture
+    # Per-request span record (sav_tpu/serve/telemetry.py RequestTrace);
+    # None when telemetry is off. Stamps are host-clock appends only —
+    # the drain's tracing cost is one list append per stage (SAV116).
+    trace: Any = None
 
     @property
     def deadline_t(self) -> float:
@@ -167,14 +172,20 @@ class DynamicBatcher:
     # ---------------------------------------------------------- admission
 
     def submit(
-        self, payload: Any, *, deadline_s: Optional[float] = None
+        self,
+        payload: Any,
+        *,
+        deadline_s: Optional[float] = None,
+        trace: Any = None,
     ) -> ServeFuture:
         """Admit one request; returns the future its result arrives on.
 
         Raises :class:`QueueFullError` when the bounded queue is at
         capacity (the caller sheds load — an unbounded queue would turn
         overload into an unbounded latency tail for *every* request) and
-        :class:`ServeClosedError` after ``close()``.
+        :class:`ServeClosedError` after ``close()``. ``trace`` is the
+        request's span record (telemetry): admission success stamps
+        ``admit`` on it — a host-clock append, nothing more (SAV116).
         """
         if self._closed.is_set():
             raise ServeClosedError("batcher is closed")
@@ -188,6 +199,7 @@ class DynamicBatcher:
             ),
             enqueue_t=now,
             future=future,
+            trace=trace,
         )
         if request.deadline_s <= 0:
             raise ValueError(
@@ -221,6 +233,13 @@ class DynamicBatcher:
                     f"exceeds the {request.deadline_s:.3f}s deadline; "
                     "shedding instead of serving a guaranteed miss"
                 )
+        # Stamp admit BEFORE the put: once the request is queued, the
+        # drain thread can pop it and stamp batch_formed immediately —
+        # an admit stamped after the put could postdate batch_formed,
+        # yielding a negative derived "queue" interval. A stamp on a
+        # request the put then rejects is harmless (the trace dies with
+        # the raised exception, never reaching the ring).
+        stamp(trace, "admit", self._clock())
         try:
             self._queue.put_nowait(request)
         except queue.Full:
@@ -295,11 +314,14 @@ class DynamicBatcher:
                 earliest_deadline = min(earliest_deadline, request.deadline_t)
         with self._lock:
             self._inflight += 1
+        formed_t = self._clock()
+        for request in batch:
+            stamp(request.trace, "batch_formed", formed_t)
         return FormedBatch(
             requests=batch,
             bucket=self.ladder.bucket_for(len(batch)),
             queue_depth=self._queue.qsize(),
-            formed_t=self._clock(),
+            formed_t=formed_t,
         )
 
     def mark_completed(self) -> None:

@@ -62,6 +62,7 @@ from sav_tpu.serve.batcher import (
 )
 from sav_tpu.serve.bucketing import BucketLadder, default_ladder
 from sav_tpu.serve.latency import LatencyLedger
+from sav_tpu.serve.telemetry import ServeTelemetry, stamp
 
 
 @dataclasses.dataclass
@@ -99,6 +100,35 @@ class ServeConfig:
     # Sink for the serving run manifest (None disables).
     log_dir: Optional[str] = None
     seed: int = 0
+    # ---- serve telemetry (sav_tpu/serve/telemetry.py; docs/serving.md).
+    # Per-request span tracing + live windowed metrics + SLO accounting
+    # are in-memory even without a log_dir; heartbeats / slow-request
+    # exemplars / anomaly captures need log_dir to land anywhere.
+    telemetry: bool = True
+    # Trailing window for the live p50/p99/throughput/queue view.
+    telemetry_window_s: float = 30.0
+    # Serve heartbeat cadence (kind=serve lines in fleet/proc_<i>.jsonl;
+    # 0 disables the thread).
+    heartbeat_secs: float = 5.0
+    # Completed request traces kept in the span ring.
+    trace_ring: int = 256
+    # Slow-request exemplar bundles dumped per run (serve_traces/).
+    slow_exemplars: int = 8
+    # Slow gate: latency beyond median + slow_sigma scaled MADs of the
+    # live window flags a request as a slow exemplar (and arms the
+    # anomaly profiler).
+    slow_sigma: float = 4.0
+    # SLO: deadline-hit-rate objective + Google-SRE two-window burn
+    # alerting (docs/serving.md "SLO knobs").
+    slo_target: float = 0.99
+    slo_fast_window_s: float = 60.0
+    slo_slow_window_s: float = 600.0
+    slo_burn_threshold: float = 2.0
+    # Anomaly-triggered bounded profiling (PR-7 AutoProfiler budget
+    # machinery; trace window counted in completed batches).
+    autoprof: bool = True
+    autoprof_batches: int = 4
+    autoprof_max: int = 2
 
     def to_json(self) -> str:
         return json.dumps(dataclasses.asdict(self), indent=2)
@@ -186,6 +216,7 @@ class ServeEngine:
         manifest=None,
         place_hook: Optional[Callable[[FormedBatch], None]] = None,
         execute_hook: Optional[Callable[[FormedBatch], None]] = None,
+        autoprof=None,
     ):
         self.config = config
         self.ladder = config.ladder()
@@ -301,7 +332,6 @@ class ServeEngine:
                 if scratch is not None else None
             ),
         }
-        self.ledger = LatencyLedger()
         self.manifest = manifest
         if self.manifest is None and config.log_dir:
             from sav_tpu.obs.manifest import RunManifest
@@ -318,6 +348,71 @@ class ServeEngine:
             self.manifest.begin()
         if self.manifest is not None:
             self.manifest.note("serve_startup", self.startup_report)
+        # ---- telemetry: spans + live windows + heartbeats + SLO --------
+        self._telemetry: Optional[ServeTelemetry] = None
+        self._watermark = None
+        if config.telemetry:
+            writer = None
+            if config.log_dir and config.heartbeat_secs > 0:
+                from sav_tpu.obs.fleet import (
+                    HeartbeatWriter,
+                    resolve_identity,
+                )
+
+                proc, procs = resolve_identity()
+                writer = HeartbeatWriter(
+                    config.log_dir,
+                    process_index=proc,
+                    process_count=procs,
+                )
+            if autoprof is None and config.autoprof and config.log_dir:
+                from sav_tpu.obs.autoprof import AutoProfiler
+                from sav_tpu.obs.fleet import resolve_identity
+
+                autoprof = AutoProfiler(
+                    config.log_dir,
+                    trace_steps=config.autoprof_batches,
+                    max_captures=config.autoprof_max,
+                    process_index=resolve_identity()[0],
+                    manifest=self.manifest,
+                )
+            from sav_tpu.obs.memdump import HbmWatermark
+
+            self._watermark = HbmWatermark()
+
+            def _hbm() -> Optional[dict]:
+                self._watermark.observe()
+                if not self._watermark.samples:
+                    return None
+                return {
+                    "hbm_bytes_in_use": self._watermark.in_use_bytes,
+                    "hbm_peak_bytes": self._watermark.peak_bytes,
+                }
+
+            self._telemetry = ServeTelemetry(
+                config.log_dir,
+                trace_ring=config.trace_ring,
+                exemplar_max=config.slow_exemplars,
+                exemplar_sigma=config.slow_sigma,
+                window_s=config.telemetry_window_s,
+                heartbeat_secs=config.heartbeat_secs,
+                slo_target=config.slo_target,
+                slo_fast_window_s=config.slo_fast_window_s,
+                slo_slow_window_s=config.slo_slow_window_s,
+                slo_burn_threshold=config.slo_burn_threshold,
+                writer=writer,
+                autoprof=autoprof,
+                queue_stats_fn=lambda: (
+                    self._batcher.stats() if self._batcher else {}
+                ),
+                hbm_fn=_hbm,
+            )
+        self.ledger = LatencyLedger(
+            window=(
+                self._telemetry.window
+                if self._telemetry is not None else None
+            )
+        )
         self._batcher: Optional[DynamicBatcher] = None
         self._feeder = None
         self._device_thread: Optional[threading.Thread] = None
@@ -434,6 +529,8 @@ class ServeEngine:
         )
         self._started = True
         self.ledger.start()
+        if self._telemetry is not None:
+            self._telemetry.start()
         self._device_thread.start()
         return self
 
@@ -471,6 +568,10 @@ class ServeEngine:
             valid = np.zeros((formed.bucket,), np.float32)
             valid[:n] = 1.0
             placed = self._place_host_batch(images, valid)
+            if self._telemetry is not None:
+                t_placed = self._telemetry.clock()
+                for request in formed.requests:
+                    stamp(request.trace, "placed", t_placed)
             if self.place_hook is not None:
                 self.place_hook(formed)
             return formed, placed
@@ -492,12 +593,24 @@ class ServeEngine:
             for formed, placed in self._feeder:
                 t0 = time.perf_counter()
                 try:
+                    if self._telemetry is not None:
+                        t_dispatch = self._telemetry.clock()
+                        for request in formed.requests:
+                            stamp(request.trace, "dispatched", t_dispatch)
                     if self.execute_hook is not None:
+                        # After the dispatched stamp: a hook that holds
+                        # the batch "on device" (the overlap/anomaly
+                        # tests) books as device time, not dispatch wait.
                         self.execute_hook(formed)
                     out = self._executables[formed.bucket](
                         self._params, self._batch_stats, placed
                     )
-                    self._complete(formed, np.asarray(out), t0)
+                    host = np.asarray(out)
+                    if self._telemetry is not None:
+                        t_exec = self._telemetry.clock()
+                        for request in formed.requests:
+                            stamp(request.trace, "executed", t_exec)
+                    self._complete(formed, host, t0)
                 except Exception as e:  # noqa: BLE001 — fail batch, serve on
                     self._errors += 1
                     self._batcher.mark_completed()
@@ -521,9 +634,14 @@ class ServeEngine:
         prev = self._step_est.get(formed.bucket, step_s)
         self._step_est[formed.bucket] = 0.8 * prev + 0.2 * step_s
         now = time.monotonic()
+        telemetry = self._telemetry
         latencies, overruns = [], []
         for i, request in enumerate(formed.requests):
+            if telemetry is not None:
+                stamp(request.trace, "depadded", telemetry.clock())
             request.future.set_result(logits[i])
+            if telemetry is not None:
+                stamp(request.trace, "completed", telemetry.clock())
             latencies.append(now - request.enqueue_t)
             overruns.append(now - request.deadline_t)
         self.ledger.observe_batch(
@@ -533,6 +651,15 @@ class ServeEngine:
             queue_depth=formed.queue_depth,
             step_s=step_s,
         )
+        if telemetry is not None:
+            # Ring + SLO + the slow-exemplar/anomaly gates — host
+            # bookkeeping on the window the ledger just fed (SAV116).
+            telemetry.observe_completed(
+                formed,
+                latencies_s=latencies,
+                overruns_s=overruns,
+                step_s=step_s,
+            )
 
     def submit(self, image: np.ndarray, *, deadline_ms: Optional[float] = None):
         """Admit one preprocessed uint8 request; returns its future.
@@ -553,15 +680,24 @@ class ServeEngine:
                 f"{image.shape} {image.dtype}; run preprocess_request() "
                 "(or submit_raw) first"
             )
+        deadline_s = (
+            deadline_ms / 1e3 if deadline_ms is not None
+            else self.config.deadline_ms / 1e3
+        )
+        trace = (
+            self._telemetry.begin_trace(deadline_s)
+            if self._telemetry is not None else None
+        )
         try:
             return self._batcher.submit(
                 image,
-                deadline_s=(
-                    deadline_ms / 1e3 if deadline_ms is not None else None
-                ),
+                deadline_s=deadline_s,
+                trace=trace,
             )
         except QueueFullError:
             self.ledger.observe_rejected()
+            if self._telemetry is not None:
+                self._telemetry.observe_shed()
             raise
 
     def submit_raw(
@@ -605,21 +741,57 @@ class ServeEngine:
         if self._feeder is not None:
             self._feeder.close()
         summary = self.ledger.summary()
-        if self.manifest is not None:
+        if error is not None:
             from sav_tpu.obs.manifest import classify_exception
 
+            outcome, detail = classify_exception(error), repr(error)
+        elif self._errors:
+            outcome, detail = "error", f"{self._errors} batch(es) failed"
+        else:
+            outcome, detail = "ok", None
+        tele_summary = None
+        if self._telemetry is not None:
+            if self._watermark is not None:
+                try:
+                    self._watermark.finalize()
+                except Exception:
+                    pass
+            tele_summary = self._telemetry.close(outcome)
+        if self.manifest is not None:
             metrics = self.ledger.flat_metrics()
             if self.startup_report.get("compiled_from_scratch") is not None:
                 metrics["serve/compiled_from_scratch"] = float(
                     self.startup_report["compiled_from_scratch"]
                 )
             self.manifest.note("serve_summary", summary)
-            if error is not None:
-                outcome, detail = classify_exception(error), repr(error)
-            elif self._errors:
-                outcome, detail = "error", f"{self._errors} batch(es) failed"
-            else:
-                outcome, detail = "ok", None
+            if tele_summary is not None:
+                slo = tele_summary.get("slo") or {}
+                # SLO facts flow manifest -> normalize_run_record ->
+                # sentinel (slo_hit_frac higher-better); absent on
+                # zero-request runs — skipped, never zero-filled.
+                if isinstance(slo.get("hit_frac"), (int, float)):
+                    metrics["serve/slo_hit_frac"] = float(slo["hit_frac"])
+                if isinstance(slo.get("burn_rate"), (int, float)):
+                    metrics["serve/burn_rate"] = float(slo["burn_rate"])
+                metrics["serve/shed"] = float(tele_summary.get("shed", 0))
+                self.manifest.note("serve_telemetry", {
+                    "slo": slo,
+                    "window": tele_summary.get("window"),
+                    "exemplars": tele_summary.get("exemplars"),
+                    "heartbeats": tele_summary.get("heartbeats"),
+                    "traced": tele_summary.get("traced"),
+                    "overhead_s": tele_summary.get("overhead_s"),
+                    "autoprof": tele_summary.get("autoprof"),
+                })
+            if (
+                self._watermark is not None
+                and self._watermark.source is not None
+            ):
+                # source "device-stats" on accelerators; finalize()'s
+                # "live-arrays" backfill keeps the field present on CPU.
+                metrics["serve/hbm_peak_bytes"] = float(
+                    self._watermark.peak_bytes
+                )
             self.manifest.finalize(outcome, error=detail, metrics=metrics)
         return summary
 
@@ -636,4 +808,10 @@ class ServeEngine:
             out["batcher"] = self._batcher.stats()
         if self._feeder is not None:
             out["feeder"] = self._feeder.stats()
+        if self._telemetry is not None:
+            # The live mid-run view: windowed percentiles (None before
+            # the first completed batch — never an exception) + SLO burn.
+            out["live"] = self.ledger.live()
+            out["slo"] = self._telemetry.slo.state()
+            out["telemetry"] = self._telemetry.stats()
         return out

@@ -49,7 +49,12 @@ class LatencyLedger:
     fraction, queue stats, and deadline-overrun accounting.
     """
 
-    def __init__(self, clock: Callable[[], float] = time.perf_counter):
+    def __init__(
+        self,
+        clock: Callable[[], float] = time.perf_counter,
+        *,
+        window=None,
+    ):
         self._clock = clock
         self._lock = threading.Lock()
         self._t0: Optional[float] = None
@@ -61,6 +66,12 @@ class LatencyLedger:
         self._queue_max = 0
         self._step_s = 0.0
         self._rejected = 0
+        # Live windowed view (sav_tpu/serve/telemetry.py LiveWindow or
+        # None): fed from the SAME observation path as the cumulative
+        # accumulators, so the final summary() stays bit-identical with
+        # the window on or off (tests/test_serve_telemetry.py pins it)
+        # while mid-run percentiles become observable via live().
+        self._window = window
 
     def start(self) -> None:
         """Mark the start of the serving window (throughput denominator).
@@ -90,11 +101,29 @@ class LatencyLedger:
             self._queue_sum += int(queue_depth)
             self._queue_max = max(self._queue_max, int(queue_depth))
             self._step_s += float(step_s)
+        if self._window is not None:
+            self._window.observe_window(
+                latencies_s=latencies_s,
+                overruns_s=overruns_s,
+                bucket=bucket,
+                queue_depth=queue_depth,
+                step_s=step_s,
+            )
 
     def observe_rejected(self, n: int = 1) -> None:
         """Requests refused at admission (bounded queue full)."""
         with self._lock:
             self._rejected += int(n)
+        if self._window is not None:
+            self._window.observe_shed(n)
+
+    def live(self) -> Optional[dict]:
+        """The windowed mid-run view (None with no window attached).
+        Safe at any point — before the first completed batch the
+        percentiles are None, never an exception."""
+        if self._window is None:
+            return None
+        return self._window.snapshot()
 
     @property
     def requests(self) -> int:

@@ -1,0 +1,27 @@
+"""Known-bad fixture for SAV116: device syncs in the serve-telemetry
+span/window/heartbeat path — a pipeline drain inside a span stamp, a
+device_get in the window observation, a float() pulling a device metric
+through __float__ in the batch-completion path, and a blocking read in
+the heartbeat emitter."""
+import jax
+
+
+def stamp(trace, stage, t):
+    t.block_until_ready()
+    trace.stamps.append((stage, t))
+
+
+class LiveWindow:
+    def observe_window(self, latencies_s):
+        host = jax.device_get(latencies_s)
+        self.samples.extend(host)
+
+
+class ServeTelemetry:
+    def observe_completed(self, formed, metrics):
+        self.last_loss = float(metrics["loss"])
+        self.batches += 1
+
+    def serve_beat(self, metrics):
+        record = {"p99": metrics["p99"].item()}
+        self.writer.append(record)

@@ -1,0 +1,31 @@
+"""Known-clean fixture for SAV116: the nearest legitimate idioms — span
+stamps are host-clock list appends, window observation folds host floats
+the device loop already fetched with its one sanctioned sync, and the
+heartbeat emitter writes one JSON line from window snapshots."""
+import json
+import time
+
+
+def stamp(trace, stage, t):
+    # Host-clock append only: the whole cost of tracing a stage.
+    if trace is not None:
+        trace.stamps.append((stage, t))
+
+
+class LiveWindow:
+    def observe_window(self, latencies_s):
+        # latencies_s are host floats (computed from wall clocks after
+        # the device loop's post-execution fetch) — plain bookkeeping.
+        now = time.monotonic()
+        for v in latencies_s:
+            self.samples.append((now, v))
+
+
+class ServeTelemetry:
+    def observe_completed(self, formed, latencies_s):
+        self.batches += 1
+        self.completed += len(latencies_s)
+
+    def serve_beat(self):
+        record = {"t": time.time(), "w": self.window.snapshot()}
+        self.writer.write(json.dumps(record) + "\n")

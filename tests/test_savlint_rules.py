@@ -118,6 +118,12 @@ BAD_EXPECTATIONS = {
         ("SAV115", 22),  # float(metrics) on a bare name in _formed_batches()
         ("SAV115", 26),  # .block_until_ready() in the placement stage
     ],
+    "sav116_bad.py": [
+        ("SAV116", 10),  # .block_until_ready() inside a span stamp
+        ("SAV116", 16),  # jax.device_get in the window observation
+        ("SAV116", 22),  # float(metrics[...]) in observe_completed()
+        ("SAV116", 26),  # metrics[...].item() in the heartbeat emitter
+    ],
 }
 
 CLEAN_FIXTURES = [
@@ -136,6 +142,7 @@ CLEAN_FIXTURES = [
     "sav113_clean.py",
     "sav_tpu/obs/sav114_clean.py",
     "sav115_clean.py",
+    "sav116_clean.py",
 ]
 
 

@@ -86,11 +86,20 @@ def test_serve_hot_loop_suppressions_are_the_known_set():
     assert result.findings == []
     sav115 = [f for f in result.suppressed if f.rule == "SAV115"]
     assert [os.path.basename(f.path) for f in sav115] == ["engine.py"]
+    # SAV116 (serve-telemetry hot path): zero suppressions anywhere —
+    # span stamping, window observation, and heartbeating add NO device
+    # syncs, with no sanctioned exceptions.
+    assert [f for f in result.suppressed if f.rule == "SAV116"] == []
     batcher = lint_paths(
         [os.path.join(ROOT, "sav_tpu", "serve", "batcher.py")], root=ROOT
     )
     assert batcher.findings == []
     assert batcher.suppressed == []
+    telemetry = lint_paths(
+        [os.path.join(ROOT, "sav_tpu", "serve", "telemetry.py")], root=ROOT
+    )
+    assert telemetry.findings == []
+    assert telemetry.suppressed == []
 
 
 def test_library_exit_suppressions_are_the_two_contracts():

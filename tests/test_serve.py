@@ -809,6 +809,11 @@ def test_serve_bench_line_and_warm_cache_restart(tmp_path):
     assert cold["startup"]["cache_hits"] == 0
     assert warm["startup"]["compiled_from_scratch"] == 0
     assert warm["startup"]["cache_hits"] == 2
+    # --- r11 telemetry rides the line: SLO + the telemetry block --------
+    for line in (cold, warm):
+        assert line["slo_hit_frac"] == 1.0  # every request met its budget
+        assert line["burn_rate"] == 0.0
+        assert line["telemetry"]["exemplars"] == 0
     # --- backed by a finalized manifest the sentinel can score ----------
     with open(warm_manifest) as f:
         manifest = json.load(f)
@@ -818,6 +823,8 @@ def test_serve_bench_line_and_warm_cache_restart(tmp_path):
         warm["p99_latency_ms"]
     )
     assert manifest["metrics"]["serve/compiled_from_scratch"] == 0.0
+    assert manifest["metrics"]["serve/slo_hit_frac"] == 1.0
+    assert manifest["notes"]["serve_telemetry"]["slo"]["target"] == 0.99
 
 
 # -------------------------------------------------- preprocess parity

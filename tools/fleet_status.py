@@ -36,64 +36,15 @@ _REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 if _REPO_ROOT not in sys.path:  # runnable as a script from anywhere
     sys.path.insert(0, _REPO_ROOT)
 
-# Stdlib-only module (no jax) — the laptop-safety contract holds.
+# Stdlib-only modules (no jax) — the laptop-safety contract holds.
 from sav_tpu.obs.fleet import (  # noqa: E402
     aggregate_fleet,
     fleet_dir,
+    format_unix as _fmt_unix,
+    read_autoprof_captures as autoprof_captures,
     read_probe_timeline,
 )
-
-
-def _fmt_unix(t) -> str:
-    if not isinstance(t, (int, float)):
-        return "?"
-    import datetime
-
-    return datetime.datetime.fromtimestamp(t).strftime("%H:%M:%S")
-
-
-def autoprof_captures(log_dir: str) -> list:
-    """Anomaly-profiler captures: the run manifest's ``notes.autoprof``
-    merged with every process's sidecar (``autoprof/proc*_captures.jsonl``
-    — non-zero processes run with a disabled manifest, so the
-    straggler's own trace only exists in its sidecar). Deduplicated by
-    trace path."""
-    captures: list = []
-    path = os.path.join(log_dir, "manifest.json")
-    try:
-        with open(path) as f:
-            doc = json.load(f)
-        noted = (doc.get("notes") or {}).get("autoprof")
-        if isinstance(noted, list):
-            captures.extend(c for c in noted if isinstance(c, dict))
-    except (OSError, json.JSONDecodeError):
-        pass
-    import glob
-
-    for sidecar in sorted(
-        glob.glob(os.path.join(log_dir, "autoprof", "proc*_captures.jsonl"))
-    ):
-        try:
-            with open(sidecar) as f:
-                for line in f:
-                    line = line.strip()
-                    if not line:
-                        continue
-                    try:
-                        captures.append(json.loads(line))
-                    except json.JSONDecodeError:
-                        continue
-        except OSError:
-            continue
-    seen: set = set()
-    unique = []
-    for c in captures:
-        key = c.get("path")
-        if key in seen:
-            continue
-        seen.add(key)
-        unique.append(c)
-    return unique
+from sav_tpu.serve.telemetry import aggregate_serve  # noqa: E402
 
 
 def render(log_dir: str, summary: dict, out) -> None:
@@ -188,6 +139,31 @@ def render(log_dir: str, summary: dict, out) -> None:
                     f"step {e.get('step')} ({_fmt_unix(e.get('t'))})",
                     file=out,
                 )
+    serve = summary.get("serve") or {}
+    replicas = serve.get("replicas") or {}
+    if replicas:
+        # kind=serve heartbeat streams (sav_tpu/serve/telemetry.py):
+        # the per-replica router view — windowed p99 / queue / occupancy
+        # per process (full detail: tools/serve_status.py).
+        fleet_line = serve.get("fleet") or {}
+        print(
+            f"Serve replicas: {len(replicas)} "
+            f"({fleet_line.get('throughput_rps')} req/s total, worst p99 "
+            f"{fleet_line.get('worst_p99_ms')} ms)",
+            file=out,
+        )
+        for proc in sorted(replicas, key=int):
+            v = replicas[proc]
+            occ = v.get("occupancy")
+            flame = "  <-- SLO BURNING" if v.get("burning") else ""
+            print(
+                f"  replica {proc}: p99 {v.get('p99_ms')} ms, "
+                f"{v.get('throughput_rps')} req/s, queue "
+                f"{v.get('queue_depth')}, inflight {v.get('inflight')}"
+                + (f", occupancy {occ:.0%}" if occ is not None else "")
+                + f", shed {v.get('shed')}{flame}",
+                file=out,
+            )
     probes = read_probe_timeline(log_dir)
     if probes:
         attempts = [p for p in probes if p.get("kind") == "probe"]
@@ -263,6 +239,11 @@ def main(argv=None) -> int:
     summary = aggregate_fleet(args.log_dir, straggler_k=args.straggler_k)
     summary["autoprof"] = autoprof_captures(args.log_dir)
     summary["probe_timeline"] = read_probe_timeline(args.log_dir)
+    # Serve heartbeats (kind=serve) share the fleet/proc_*.jsonl files;
+    # fold the per-replica serving view in when any process emitted them.
+    serve = aggregate_serve(args.log_dir)
+    if serve.get("replicas"):
+        summary["serve"] = serve
     # Supervised runs (train.py --supervise, docs/elasticity.md): fold
     # the restart chain's headline into the fleet view — the heartbeat
     # streams this tool reads span ALL attempts, and a reader should

@@ -25,8 +25,10 @@ benches; untraced records are skipped, not zero-filled),
 ``goodput_frac`` (elastic-training goodput from supervisor manifest
 chains, higher — supervised runs only, docs/elasticity.md),
 ``p99_latency_ms`` (serving tail latency from ``tools/serve_bench.py``,
-lower) and ``serve_throughput`` (serving req/s, higher — both present
-only on serving records, docs/serving.md). Infra failures
+lower), ``serve_throughput`` (serving req/s, higher) and
+``slo_hit_frac`` (deadline-hit fraction from the r11 serve telemetry's
+SLO tracker, higher — all present only on serving records,
+docs/serving.md). Infra failures
 are *reported but never scored* — a down relay is
 not a regression (the BENCH_r05 lesson), and a history whose only deltas
 are infra failures exits clean.
@@ -96,6 +98,16 @@ METRICS = {
     # Serving request throughput (req/s over the serving window). Higher
     # is better. Same presence contract as p99_latency_ms.
     "serve_throughput": (True, 0.0),
+    # Serving SLO hit fraction (share of requests that met their
+    # deadline, incl. shed requests as misses — sav_tpu/serve/telemetry
+    # SLOTracker via the serve manifest / serve_bench line;
+    # docs/serving.md). Higher is better — a drop means the tail
+    # started blowing budgets even if mean throughput held. Present
+    # only on r11+ serving records; older serve records and training
+    # records are skipped, not zero-filled (the attention_core_frac
+    # contract). Absolute floor: one point of hit rate — a flat 1.0
+    # history must not flag a single 0.997 blip.
+    "slo_hit_frac": (True, 0.01),
 }
 
 EXIT_CLEAN, EXIT_REGRESSION, EXIT_USAGE = 0, 1, 2

@@ -56,6 +56,11 @@ from sav_tpu.obs.fleet import (  # noqa: E402
     read_probe_timeline,
 )
 from sav_tpu.obs.manifest import load_run_history  # noqa: E402
+from sav_tpu.serve.telemetry import (  # noqa: E402
+    aggregate_serve,
+    find_exemplars,
+    find_serve_manifests,
+)
 
 
 def _fmt_seconds(s: float) -> str:
@@ -556,6 +561,76 @@ def report_fleet(log_dir: str, out) -> None:
               "(fleet/backend_probe.jsonl)", file=out)
 
 
+def report_serve(log_dir: str, out, manifests: list = None) -> None:
+    """Render the serve-telemetry view (docs/serving.md): kind=serve
+    manifests, the windowed heartbeat headline per replica, SLO burn
+    state, and the slow-request exemplar index. Degrades gracefully — a
+    PR-10-era serve dir (manifest, no telemetry artifacts) renders its
+    manifest and notes the missing telemetry instead of erroring.
+    ``manifests`` takes the already-loaded kind=serve manifest list
+    (main()'s auto-detect globs+parses them — don't pay it twice)."""
+    if manifests is None:
+        manifests = find_serve_manifests(log_dir)
+    serve = aggregate_serve(log_dir)
+    replicas = serve.get("replicas") or {}
+    exemplars = find_exemplars(log_dir)
+    if not manifests and not replicas:
+        print(f"(no serve telemetry under {log_dir})", file=out)
+        return
+    for m in manifests:
+        metrics = m.get("metrics") or {}
+        outcome = m.get("outcome", "?")
+        flag = "" if outcome in ("ok", "running") else "  <-- NOT ok"
+        print(
+            f"Serve manifest {os.path.basename(m.get('path') or '?')}: "
+            f"outcome={outcome}{flag}",
+            file=out,
+        )
+        p99 = metrics.get("serve/p99_latency_ms")
+        if p99 is not None:
+            slo = metrics.get("serve/slo_hit_frac")
+            print(
+                f"  p99 {p99} ms, {metrics.get('serve/throughput_rps')} "
+                "req/s"
+                + (f", SLO hit {slo:.2%}" if slo is not None else "")
+                + (
+                    f", burn rate {metrics.get('serve/burn_rate')}"
+                    if metrics.get("serve/burn_rate") is not None else ""
+                ),
+                file=out,
+            )
+    if replicas:
+        for proc in sorted(replicas, key=int):
+            v = replicas[proc]
+            flame = "  <-- SLO BURNING" if v.get("burning") else ""
+            print(
+                f"  serve replica {proc}: {v.get('beats')} heartbeats — "
+                f"windowed p99 {v.get('p99_ms')} ms, "
+                f"{v.get('throughput_rps')} req/s, queue "
+                f"{v.get('queue_depth')}, shed {v.get('shed')}{flame}",
+                file=out,
+            )
+    else:
+        print(
+            "  (no serve telemetry — heartbeats/windows/exemplars need "
+            "an r11+ engine with telemetry on)",
+            file=out,
+        )
+    if exemplars:
+        print(
+            f"  slow-request exemplars: {len(exemplars)} "
+            f"(see tools/serve_status.py {log_dir})",
+            file=out,
+        )
+        for e in exemplars[:5]:
+            print(
+                f"    req {e.get('rid')}: {e.get('latency_ms')} ms "
+                f"(overrun {e.get('overrun_ms')} ms) — "
+                f"{e.get('dominant_stage')} dominated",
+                file=out,
+            )
+
+
 def report_chain(log_dir: str, out) -> None:
     """Render a supervisor manifest chain (docs/elasticity.md):
     attempts, restart reasons, resumed-from steps, lost time, skipped
@@ -705,6 +780,15 @@ def main(argv=None) -> int:
         "bundles are also rendered automatically when the directory "
         "exists",
     )
+    parser.add_argument(
+        "--serve", action="store_true",
+        help="render the log dir's serve telemetry (kind=serve "
+        "manifests, windowed heartbeat headline, SLO burn state, "
+        "slow-request exemplars — docs/serving.md); also rendered "
+        "automatically when a kind=serve manifest or serve heartbeat "
+        "stream exists. PR-10-era serve dirs degrade to a '(no serve "
+        "telemetry)' note.",
+    )
     args = parser.parse_args(argv)
     if (
         args.log_dir is None and args.metrics is None
@@ -729,6 +813,10 @@ def main(argv=None) -> int:
         if args.bench is None:
             parser.error("--chain needs a log dir to look under")
         print("(--chain ignored: no log dir given)", file=sys.stderr)
+    if args.serve and args.log_dir is None:
+        if args.bench is None:
+            parser.error("--serve needs a log dir to look under")
+        print("(--serve ignored: no log dir given)", file=sys.stderr)
 
     if args.bench:
         rc = report_bench_history(args.bench, sys.stdout)
@@ -779,6 +867,16 @@ def main(argv=None) -> int:
         or os.path.isdir(os.path.join(args.log_dir, "incidents"))
     ):
         report_incidents(args.log_dir, out)
+
+    serve_manifests = (
+        find_serve_manifests(args.log_dir) if args.log_dir else []
+    )
+    if args.log_dir and (
+        args.serve
+        or os.path.isdir(os.path.join(args.log_dir, "serve_traces"))
+        or serve_manifests
+    ):
+        report_serve(args.log_dir, out, manifests=serve_manifests)
 
     if args.log_dir and (
         args.trace or os.path.isdir(os.path.join(args.log_dir, "autoprof"))

@@ -68,6 +68,13 @@ def run(args, manifest) -> dict:
         deadline_ms=args.deadline_ms,
         checkpoint_dir=args.checkpoint,
         compilation_cache_dir=args.compilation_cache_dir,
+        # Telemetry artifacts (serve heartbeats, slow-request exemplars,
+        # anomaly captures) land next to the manifest; --no-telemetry is
+        # the A/B arm the <2% overhead proof measures against.
+        log_dir=args.log_dir,
+        telemetry=not args.no_telemetry,
+        heartbeat_secs=args.heartbeat_secs,
+        slo_target=args.slo_target,
     )
     engine = ServeEngine(config, manifest=manifest)
     rng = np.random.default_rng(0)
@@ -100,11 +107,14 @@ def run(args, manifest) -> dict:
         for future in futures:
             future.result(timeout=max(deadline - time.monotonic(), 0.1))
     summary = engine.stop()
+    stats = engine.stats()
     return {
         "summary": summary,
         "startup": engine.startup_report,
         "offered": args.requests,
         "rejected_at_submit": rejected,
+        "slo": stats.get("slo"),
+        "telemetry": stats.get("telemetry"),
     }
 
 
@@ -156,6 +166,26 @@ def main(argv=None) -> int:
     parser.add_argument("--compilation-cache-dir", default=None)
     parser.add_argument("--attn-tune-cache", default=None)
     parser.add_argument(
+        "--log-dir", default=None,
+        help="serve telemetry sink (heartbeats, slow-request exemplars, "
+        "anomaly captures; default: the manifest's directory)",
+    )
+    parser.add_argument(
+        "--no-telemetry", action="store_true",
+        help="disable serve telemetry (spans/windows/heartbeats/SLO) — "
+        "the overhead A/B arm (docs/serving.md)",
+    )
+    parser.add_argument(
+        "--heartbeat-secs", type=float, default=5.0,
+        help="serve heartbeat cadence (kind=serve lines in "
+        "fleet/proc_<i>.jsonl; 0 disables)",
+    )
+    parser.add_argument(
+        "--slo-target", type=float, default=0.99,
+        help="deadline-hit-rate SLO objective (burn rates are measured "
+        "against the 1-target error budget)",
+    )
+    parser.add_argument(
         "--backend-wait", type=float, default=600.0,
         help="seconds to poll for the accelerator relay before giving up "
         "(0 disables)",
@@ -173,6 +203,8 @@ def main(argv=None) -> int:
             f"manifest-serve-{time.strftime('%Y%m%d-%H%M%S')}"
             f"-{os.getpid()}.json",
         )
+    if args.log_dir is None:
+        args.log_dir = os.path.dirname(args.manifest) or "."
 
     from sav_tpu.obs.manifest import RunManifest, classify_exception
 
@@ -229,6 +261,10 @@ def main(argv=None) -> int:
     import jax
 
     summary = result["summary"]
+    # A zero-request run (instantly-closed engine, everything shed) is
+    # an honest measurement of "nothing was served": the latency keys
+    # are null and slo_hit_frac is absent — never a traceback, and the
+    # sentinel skips rather than zero-fills (docs/serving.md).
     latency = summary.get("latency_ms", {})
     ladder_desc = "bs1" if args.batch_1 else (
         args.buckets or f"pow2<={args.max_batch}"
@@ -257,6 +293,18 @@ def main(argv=None) -> int:
         "startup": result["startup"],
         "manifest": manifest.path,
     }
+    slo = result.get("slo") or {}
+    if isinstance(slo.get("hit_frac"), (int, float)):
+        out["slo_hit_frac"] = slo["hit_frac"]
+        out["burn_rate"] = slo.get("burn_rate")
+    telemetry = result.get("telemetry")
+    if telemetry is not None:
+        out["telemetry"] = {
+            "heartbeats": int(telemetry.get("heartbeats", 0)),
+            "exemplars": int(telemetry.get("exemplars", 0)),
+            "overhead_s": telemetry.get("overhead_s"),
+            "log_dir": args.log_dir,
+        }
     # Engine.stop() finalized the manifest with the serve/* metrics
     # (sav_tpu/obs/manifest.py reads serve/p99_latency_ms and
     # serve/throughput_rps back out as the sentinel's metric names);

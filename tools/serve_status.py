@@ -1,0 +1,209 @@
+#!/usr/bin/env python
+"""Render a serve process's telemetry from artifacts alone.
+
+The serving twin of ``tools/fleet_status.py`` (docs/serving.md): reads
+the artifact layout the engine's telemetry layer writes
+(``sav_tpu/serve/telemetry.py``) and re-aggregates it offline —
+
+  fleet/proc_<i>.jsonl          kind=serve heartbeat streams (windowed
+                                p99/throughput/queue/occupancy, SLO burn)
+  serve_traces/slow_*.json      slow-request exemplar bundles (full span
+                                detail + the gate that flagged them)
+  serve_traces/*.trace.json.gz  the span ring's chrome-trace export
+  manifest*-serve-*.json        the PR-10 serve manifests (kind=serve)
+  autoprof/                     anomaly-triggered bounded captures
+
+A *live* serve process is observable from here mid-run: the heartbeat
+stream carries the windowed view, so ``serve_status`` on a log dir whose
+manifest is still ``running`` reports current p99 / queue depth /
+occupancy — no engine API needed. This per-replica view (queue depth,
+p99, occupancy per process) is the fleet router input ROADMAP item 3
+load-balances on.
+
+Stdlib-only (no jax import): safe on a laptop against rsynced logs.
+
+Usage:
+  python tools/serve_status.py runs/serve
+  python tools/serve_status.py --json runs/serve
+
+Exit codes: 0 rendered; 2 usage/IO (no such directory).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO_ROOT not in sys.path:  # runnable as a script from anywhere
+    sys.path.insert(0, _REPO_ROOT)
+
+# Stdlib-only modules (no jax) — the laptop-safety contract holds.
+from sav_tpu.obs.fleet import (  # noqa: E402
+    format_unix as _fmt_unix,
+    read_autoprof_captures as autoprof_captures,
+)
+from sav_tpu.serve.telemetry import (  # noqa: E402
+    aggregate_serve,
+    find_exemplars,
+    find_serve_manifests,
+)
+
+
+def gather(log_dir: str) -> dict:
+    summary = aggregate_serve(log_dir)
+    summary["exemplars"] = find_exemplars(log_dir)
+    summary["manifests"] = [
+        {
+            "path": m.get("path"),
+            "outcome": m.get("outcome"),
+            "metrics": m.get("metrics") or {},
+        }
+        for m in find_serve_manifests(log_dir)
+    ]
+    summary["autoprof"] = autoprof_captures(log_dir)
+    return summary
+
+
+def render(log_dir: str, summary: dict, out) -> None:
+    print(f"== Serve status: {log_dir} ==", file=out)
+    replicas = summary.get("replicas") or {}
+    if not replicas:
+        print(
+            "(no kind=serve heartbeat streams under "
+            f"{os.path.join(log_dir, 'fleet')} — telemetry off, or a "
+            "pre-telemetry serve run; manifests below, if any)",
+            file=out,
+        )
+    for proc in sorted(replicas, key=int):
+        v = replicas[proc]
+        p99 = v.get("p99_ms")
+        occ = v.get("occupancy")
+        print(
+            f"replica {proc}: {v.get('beats', 0)} heartbeats, up "
+            f"{v.get('up_s')}s, last at {_fmt_unix(v.get('last_unix'))} — "
+            f"{v.get('requests')} served, {v.get('shed')} shed",
+            file=out,
+        )
+        print(
+            "  window: "
+            + (f"p99 {p99} ms" if p99 is not None else "p99 — (idle)")
+            + f", {v.get('throughput_rps')} req/s, queue "
+            f"{v.get('queue_depth')}, inflight {v.get('inflight')}"
+            + (f", occupancy {occ:.0%}" if occ is not None else ""),
+            file=out,
+        )
+        hit = v.get("slo_hit_frac")
+        burn = v.get("burn_rate")
+        if hit is not None or burn is not None:
+            flame = "  <-- BURNING" if v.get("burning") else ""
+            print(
+                "  SLO: hit "
+                + (f"{hit:.2%}" if hit is not None else "?")
+                + f", burn rate {burn}{flame}",
+                file=out,
+            )
+        if v.get("exemplars"):
+            print(f"  slow exemplars: {v['exemplars']}", file=out)
+    fleet = summary.get("fleet") or {}
+    if replicas and fleet.get("replicas", 0) > 1:
+        print(
+            f"Fleet: {fleet['replicas']} replicas, "
+            f"{fleet.get('throughput_rps')} req/s total, worst p99 "
+            f"{fleet.get('worst_p99_ms')} ms"
+            + (
+                f", BURNING replicas {fleet['burning']}"
+                if fleet.get("burning") else ""
+            ),
+            file=out,
+        )
+    timeline = summary.get("timeline") or []
+    if timeline:
+        t0 = timeline[0].get("t") or 0.0
+        tail = timeline[-8:]
+        print(
+            "Heartbeat timeline (tail): "
+            + "  ".join(
+                f"+{(e.get('t') or 0.0) - t0:.0f}s p{e.get('proc')}"
+                f"[p99 {e.get('p99_ms')} q{e.get('queue')}]"
+                for e in tail
+            ),
+            file=out,
+        )
+    exemplars = summary.get("exemplars") or []
+    if exemplars:
+        print(f"Slow-request exemplars: {len(exemplars)}", file=out)
+        for e in exemplars:
+            print(
+                f"  req {e.get('rid')}: {e.get('latency_ms')} ms vs "
+                f"{e.get('deadline_ms')} ms deadline "
+                f"(overrun {e.get('overrun_ms')} ms) — "
+                f"{e.get('dominant_stage')} dominated "
+                f"({json.dumps(e.get('stages_ms') or {})})",
+                file=out,
+            )
+    captures = summary.get("autoprof") or []
+    if captures:
+        print(f"Anomaly captures: {len(captures)}", file=out)
+        for c in captures:
+            print(
+                f"  {c.get('trigger')} at batch {c.get('trigger_step')}: "
+                f"batches {c.get('start_step')}..{c.get('end_step')} -> "
+                f"{c.get('path')}",
+                file=out,
+            )
+    manifests = summary.get("manifests") or []
+    for m in manifests:
+        metrics = m.get("metrics") or {}
+        outcome = m.get("outcome")
+        flag = "" if outcome in ("ok", "running") else "  <-- NOT ok"
+        live = " (live — still running)" if outcome == "running" else ""
+        print(
+            f"Manifest {os.path.basename(m.get('path') or '?')}: "
+            f"outcome={outcome}{flag}{live}",
+            file=out,
+        )
+        p99 = metrics.get("serve/p99_latency_ms")
+        if p99 is not None:
+            print(
+                f"  final: p99 {p99} ms, "
+                f"{metrics.get('serve/throughput_rps')} req/s, "
+                f"SLO hit {metrics.get('serve/slo_hit_frac')}",
+                file=out,
+            )
+    if not replicas and not manifests and not exemplars:
+        print("(no serve telemetry found in this directory)", file=out)
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter,
+    )
+    parser.add_argument(
+        "log_dir",
+        help="serve log dir (the parent of its fleet/ and serve_traces/)",
+    )
+    parser.add_argument(
+        "--json", action="store_true",
+        help="emit the aggregated serve summary as JSON",
+    )
+    args = parser.parse_args(argv)
+    if not os.path.isdir(args.log_dir):
+        print(
+            f"serve_status: no such directory: {args.log_dir}",
+            file=sys.stderr,
+        )
+        return 2
+    summary = gather(args.log_dir)
+    if args.json:
+        print(json.dumps(summary, indent=2, default=str))
+    else:
+        render(args.log_dir, summary, sys.stdout)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -162,6 +162,35 @@ def render(summary: dict, out) -> None:
                 + (f"  [{scope[-60:]}]" if scope else ""),
                 file=out,
             )
+    spans = summary.get("request_spans") or {}
+    if spans:
+        # Serve request timelines (sav_tpu/serve/telemetry.py span ring
+        # export): per-request stage walk, slowest first.
+        print(
+            f"serve request timelines: {len(spans)} request(s)", file=out
+        )
+        ranked = sorted(
+            spans.items(),
+            key=lambda kv: -(kv[1].get("total_ms") or 0.0),
+        )
+        for rid, view in ranked[:10]:
+            walk = " -> ".join(
+                f"{name} {dur:.1f}ms" for name, _, dur in view["stages"]
+            )
+            overrun = view.get("overrun_ms")
+            print(
+                f"  req {rid} [bucket {view.get('bucket')}]: "
+                f"{view.get('total_ms')} ms ({walk})"
+                + (
+                    f"  OVERRAN deadline by {overrun} ms — "
+                    f"{view.get('dominant_stage')} dominated"
+                    if isinstance(overrun, (int, float)) and overrun > 0
+                    else ""
+                ),
+                file=out,
+            )
+        if len(ranked) > 10:
+            print(f"  ... and {len(ranked) - 10} more", file=out)
 
 
 def main(argv=None) -> int:
@@ -212,7 +241,15 @@ def main(argv=None) -> int:
             file=sys.stderr,
         )
         return 2
-    trace = traces[-1]
+    # Newest wins — but for the DEVICE summary, never let the serve
+    # span-ring export (serve_traces/, no device plane, written at
+    # engine stop so always newest) shadow an autoprof capture in the
+    # same log dir. Serve-only dirs still summarize the request trace.
+    device_traces = [
+        t for t in traces
+        if "serve_traces" not in os.path.normpath(t).split(os.sep)
+    ]
+    trace = (device_traces or traces)[-1]
 
     op_index = None
     if args.op_index:
@@ -242,6 +279,9 @@ def main(argv=None) -> int:
         predicted, _ = find_manifest_predicted(trace)
 
     try:
+        # One gunzip+parse feeds both the device summary and the serve
+        # request-span view — a real capture is tens of MB.
+        events = traceview.load_trace(trace)
         summary = traceview.summarize(
             trace,
             op_index=op_index,
@@ -249,10 +289,29 @@ def main(argv=None) -> int:
             steps=args.steps,
             tolerance=args.tolerance,
             top_ops=args.top,
+            events=events,
         )
     except (OSError, ValueError, json.JSONDecodeError) as e:
         print(f"trace_report: cannot parse {trace}: {e}", file=sys.stderr)
         return 2
+    try:
+        spans = traceview.request_spans(events)
+    except (ValueError, KeyError, TypeError):
+        spans = {}
+    if not spans and len(device_traces) < len(traces):
+        # A device trace won the summary slot but the dir also carries
+        # a serve span-ring export — render its request timelines too.
+        try:
+            spans = traceview.request_spans(
+                traceview.load_trace(
+                    [t for t in traces if t not in device_traces][-1]
+                )
+            )
+        except (OSError, ValueError, json.JSONDecodeError,
+                KeyError, TypeError):
+            spans = {}
+    if spans:
+        summary["request_spans"] = {str(k): v for k, v in spans.items()}
     if args.json:
         print(json.dumps(summary, indent=2))
     else:

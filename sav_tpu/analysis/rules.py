@@ -1235,6 +1235,60 @@ class ServeTelemetryHotPathSync(Rule):
                 )
 
 
+# ---------------------------------------------------------------- SAV117
+
+
+class AdhocPartitionSpec(Rule):
+    """``PartitionSpec``/``NamedSharding`` constructed outside the layout
+    module.
+
+    :class:`sav_tpu.parallel.layout.SpecLayout` is the single source of
+    truth for every param/activation spec in the repo (ISSUE 13): the
+    trainer, the serve engine, and the tools place tensors through the
+    layout's derived shardings (``BoundLayout.param_shardings`` /
+    ``batch_sharding``) or the :mod:`sav_tpu.parallel.mesh` helpers. An
+    inline ``P(...)`` or ``NamedSharding(...)`` anywhere else forks that
+    source of truth — the spec it states is invisible to the layout's
+    golden snapshots, to ``tools/mesh_tune.py``'s search space, and to
+    the ``notes.layout`` provenance stamp, so a layout change silently
+    stops covering it. Scoped to everything OUTSIDE ``sav_tpu/parallel/``
+    (the layout subsystem and the collective ops that implement it are
+    where specs legitimately originate).
+    """
+
+    id = "SAV117"
+    name = "adhoc-partition-spec"
+    severity = "warning"
+    hint = (
+        "derive the sharding from the layout (BoundLayout.param_shardings"
+        "/batch_sharding) or the sav_tpu.parallel.mesh helpers "
+        "(batch_sharding/batch_sharding_at/replicated) instead of "
+        "constructing PartitionSpec/NamedSharding inline"
+    )
+
+    LAYOUT_PATHS = ("sav_tpu/parallel/",)
+    CTORS = {
+        "jax.sharding.PartitionSpec": "PartitionSpec",
+        "jax.sharding.NamedSharding": "NamedSharding",
+    }
+
+    def check(self, module):
+        if module.relpath.startswith(self.LAYOUT_PATHS):
+            return
+        for node in ast.walk(module.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            resolved = module.resolve_call(node)
+            if resolved in self.CTORS:
+                yield _finding(
+                    self,
+                    node,
+                    f"ad-hoc {self.CTORS[resolved]}() outside "
+                    "sav_tpu/parallel/ forks the SpecLayout source of "
+                    "truth",
+                )
+
+
 # ----------------------------------------------------------- SAV100 (meta)
 
 
@@ -1302,6 +1356,7 @@ ALL_RULES = [
     BareExitInLibrary(),
     ServeHotLoopSync(),
     ServeTelemetryHotPathSync(),
+    AdhocPartitionSpec(),
 ]
 
 

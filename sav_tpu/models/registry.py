@@ -160,6 +160,7 @@ def create_model(
     logits_dtype=None,
     seq_parallel: Optional[str] = None,
     seq_mesh=None,
+    layout=None,
     **overrides,
 ):
     """Instantiate a named model config.
@@ -179,6 +180,12 @@ def create_model(
         stream, CeiT trunk — others raise).
       seq_mesh: the jax.sharding.Mesh carrying the 'seq' axis; required
         with ``seq_parallel``.
+      layout: a :class:`~sav_tpu.parallel.layout.BoundLayout` threaded to
+        models with a layout seam (ViT family): encoder blocks pin token
+        activations to the layout's activation spec — the 2D-TP
+        between-block constraint (docs/parallelism.md). Models without
+        the seam ignore it (their specs still come from the layout's
+        param rules at placement time).
       **overrides: per-call hyperparameter overrides.
     """
     if model_name not in _REGISTRY:
@@ -192,6 +199,8 @@ def create_model(
         merged["backend"] = backend
     if logits_dtype is not None and "logits_dtype" in cls.__dataclass_fields__:
         merged["logits_dtype"] = logits_dtype
+    if layout is not None and "layout" in cls.__dataclass_fields__:
+        merged["layout"] = layout
     if seq_parallel is not None:
         if "seq_parallel" not in cls.__dataclass_fields__:
             raise ValueError(

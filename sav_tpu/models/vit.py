@@ -40,6 +40,10 @@ class EncoderBlock(nn.Module):
     logits_dtype: Optional[Dtype] = None  # None = inherit dtype (softmax math)
     seq_parallel: Optional[str] = None  # 'ring'|'ulysses' over seq_mesh
     seq_mesh: Optional[Any] = None
+    # BoundLayout (sav_tpu/parallel/layout.py): pins the block's output
+    # tokens to the layout's activation spec — the 2D-TP between-block
+    # constraint. None (the default and every 1D/DP run) is a no-op.
+    layout: Optional[Any] = None
     dtype: Dtype = jnp.float32
 
     @nn.compact
@@ -73,7 +77,9 @@ class EncoderBlock(nn.Module):
                 dropout_rate=self.dropout_rate,
                 dtype=self.dtype,
             )(y, is_training)
-        return x + y
+        from sav_tpu.parallel.layout import constrain_tokens
+
+        return constrain_tokens(x + y, self.layout)
 
 
 class Encoder(nn.Module):
@@ -101,6 +107,7 @@ class Encoder(nn.Module):
     logits_dtype: Optional[Dtype] = None  # None = inherit dtype (softmax math)
     seq_parallel: Optional[str] = None  # 'ring'|'ulysses' over seq_mesh
     seq_mesh: Optional[Any] = None
+    layout: Optional[Any] = None  # see EncoderBlock.layout
     dtype: Dtype = jnp.float32
 
     @nn.compact
@@ -137,6 +144,7 @@ class Encoder(nn.Module):
                 logits_dtype=self.logits_dtype,
                 seq_parallel=self.seq_parallel,
                 seq_mesh=self.seq_mesh,
+                layout=self.layout,
                 dtype=self.dtype,
                 name=f"block_{i}",
             )(x, is_training)
@@ -164,6 +172,7 @@ class ViT(nn.Module):
     logits_dtype: Optional[Dtype] = None  # None = inherit dtype (softmax math)
     seq_parallel: Optional[str] = None  # 'ring'|'ulysses' over seq_mesh
     seq_mesh: Optional[Any] = None
+    layout: Optional[Any] = None  # see EncoderBlock.layout
     dtype: Dtype = jnp.float32
 
     @nn.compact
@@ -191,6 +200,7 @@ class ViT(nn.Module):
             logits_dtype=self.logits_dtype,
             seq_parallel=self.seq_parallel,
             seq_mesh=self.seq_mesh,
+            layout=self.layout,
             dtype=self.dtype,
         )(x, is_training)
         cls_out = x[:, 0]

@@ -1,0 +1,690 @@
+"""Declarative sharding layouts — one object that *states* how a run is
+partitioned.
+
+Before this module, every parallelism arm declared its sharding in a
+different place: TP was a regex-rule list in :mod:`sav_tpu.parallel.
+sharding`, FSDP a shard-biggest-dim heuristic bolted on after the rules,
+pipeline/MoE their own rule lists, and the batch/activation specs were
+inline ``PartitionSpec`` constructions scattered through the trainer and
+the serve engine. :class:`SpecLayout` is the canonical, serializable
+statement of a layout — named specs per layer role (qkv / out-proj /
+fc1 / fc2 / embed / norm / head, plus the expert and pipe-stage trees and
+the activation/batch specs) — from which every param and activation spec
+in the repo is derived. The legacy rule lists in ``sharding.py`` are thin
+consumers of the default layouts, and savlint SAV117 keeps ad-hoc
+``PartitionSpec`` construction out of the rest of the tree.
+
+Tensor parallelism comes in two shapes:
+
+- **1D** (``tp_heads_axis='model'``): Megatron-style — attention heads and
+  the MLP hidden dim column-split, output projections row-split; each
+  block needs exactly one AllReduce on its output.
+- **2D** (``tp_heads_axis='x'``, ``tp_feature_axis='y'``): the SUMMA-style
+  grid the 2D-TP literature prescribes — heads/hidden over ``x`` AND the
+  model feature dim over ``y``, so no single axis has to swallow the
+  whole TP degree. The collective pairing per block: the ``x``-split
+  contractions reduce over ``x`` (AllReduce), the ``y``-split feature dim
+  all-gathers/reduce-scatters over ``y`` as activations enter/leave each
+  projection — all partitioner-inserted from these specs. Activations
+  carry ``P(batch, None, 'y')`` between blocks
+  (:meth:`SpecLayout.activation_spec`; the model applies it through
+  :meth:`BoundLayout.constrain_tokens` when a layout is threaded into
+  ``create_model``).
+
+Layouts serialize to JSON (:meth:`SpecLayout.to_dict` /
+:meth:`SpecLayout.from_dict`) and round-trip through the preset files
+``tools/mesh_tune.py`` emits (:func:`save_layout_preset` /
+:func:`load_layout_preset`); ``train.py --layout-preset`` and
+``ServeConfig.layout_preset`` accept either a preset path or a built-in
+name (:func:`resolve_layout`). The chosen layout is stamped into the run
+manifest as ``notes.layout`` by the trainer and the serve engine.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import re
+import warnings
+from typing import Any, Optional
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from sav_tpu.parallel.mesh import (
+    DATA_AXIS,
+    EXPERT_AXIS,
+    FSDP_AXIS,
+    MODEL_AXIS,
+    PIPE_AXIS,
+    SEQ_AXIS,
+    create_mesh,
+)
+
+# 2D tensor-parallel axis names (SNIPPETS.md [2]: named 2D-TP specs over
+# x,y). 'x' is the major axis (heads / MLP hidden — the 1D 'model' role);
+# 'y' is the minor axis (the model feature dim).
+TP_X_AXIS = "x"
+TP_Y_AXIS = "y"
+
+_BUILTIN_NAME = re.compile(
+    r"^(dp|tp(?P<tp>\d+)|fsdp(?P<fsdp>\d+)|2d(?P<x>\d+)x(?P<y>\d+))$"
+)
+
+
+def _spec_to_jsonable(spec: P) -> list:
+    """PartitionSpec -> JSON shape: None | str | [str, ...] per entry."""
+    out = []
+    for entry in spec:
+        if entry is None or isinstance(entry, str):
+            out.append(entry)
+        else:
+            out.append(list(entry))
+    return out
+
+
+def _spec_from_jsonable(entries: list) -> P:
+    return P(*[tuple(e) if isinstance(e, list) else e for e in entries])
+
+
+def specs_from_rules(params: Any, rules: list[tuple[str, Any]]) -> Any:
+    """Tree of ``PartitionSpec`` matching ``params`` from (regex, spec)
+    rules — the one rule matcher every consumer (layout-derived and
+    custom) goes through. First matching rule whose spec fits the leaf's
+    rank wins; no match replicates."""
+    flat = jax.tree_util.tree_flatten_with_path(params)[0]
+
+    def spec_for(path, leaf):
+        path_str = "/".join(
+            k.key if hasattr(k, "key") else str(k) for k in path
+        )
+        for pattern, spec in rules:
+            if re.search(pattern, path_str) and len(spec) <= leaf.ndim:
+                return spec
+        return P()
+
+    specs = [spec_for(path, leaf) for path, leaf in flat]
+    treedef = jax.tree_util.tree_structure(params)
+    return jax.tree_util.tree_unflatten(treedef, specs)
+
+
+# ------------------------------------------------------------- FSDP augment
+
+# Warn-once registry for the FSDP replication fallback: keyed by
+# (path-or-shape, axis size) so distinct offenders each get one warning
+# and repeated sweeps over the same tree stay quiet.
+_fsdp_fallback_warned: set = set()
+
+
+def reset_fsdp_fallback_warnings() -> None:
+    """Test seam: forget which FSDP fallbacks have already warned."""
+    _fsdp_fallback_warned.clear()
+
+
+def add_fsdp_axis(
+    spec: Any,
+    shape: tuple[int, ...],
+    fsdp_size: int,
+    *,
+    min_elements: int,
+    axis: str = FSDP_AXIS,
+    path: str = "",
+) -> Any:
+    """Augment a PartitionSpec with FSDP sharding (ZeRO-3 style).
+
+    Divisibility-aware by rule: among the dims the layout left free
+    (entry ``None``), the largest one divisible by ``fsdp_size`` is
+    sharded; an indivisible biggest dim falls back to the next divisible
+    one rather than forcing an uneven shard. When NO free dim divides,
+    the parameter stays replicated — and that fallback WARNS (once per
+    offender): a silently-replicated large parameter defeats the memory
+    win FSDP was turned on for. Small tensors (< ``min_elements``) stay
+    replicated silently — sharding tiny norm scales/biases costs more in
+    collective latency than it saves in HBM.
+    """
+    import numpy as np
+
+    if int(np.prod(shape)) < min_elements:
+        return spec
+    entries = list(spec) + [None] * (len(shape) - len(spec))
+    candidates = [
+        (shape[i], i)
+        for i, e in enumerate(entries)
+        if e is None and shape[i] % fsdp_size == 0 and shape[i] >= fsdp_size
+    ]
+    if not candidates:
+        key = (path or str(shape), fsdp_size)
+        if key not in _fsdp_fallback_warned:
+            _fsdp_fallback_warned.add(key)
+            warnings.warn(
+                f"FSDP fallback: no free dim of {path or 'parameter'} "
+                f"{tuple(shape)} divides the '{axis}' axis size "
+                f"{fsdp_size}; the parameter stays REPLICATED (its HBM is "
+                "paid on every shard). Pick an fsdp size that divides the "
+                "model's dims, or accept the replication (reported once "
+                "per offender).",
+                stacklevel=2,
+            )
+        return spec
+    _, dim = max(candidates)
+    entries[dim] = axis
+    return P(*entries)
+
+
+# ---------------------------------------------------------------- SpecLayout
+
+
+@dataclasses.dataclass(frozen=True)
+class SpecLayout:
+    """Canonical, serializable sharding layout (the SNIPPETS.md [3] shape).
+
+    ``mesh_axes`` is the ordered axis→size table the mesh is built from
+    (one ``-1`` absorbs the remaining devices); the ``*_axis`` fields name
+    which of those axes carries each parallelism arm. Everything else —
+    per-role param specs, the regex rule list, batch/activation specs —
+    is *derived*, so the dataclass stays the single declarative source.
+    """
+
+    name: str = "dp"
+    mesh_axes: tuple = ((DATA_AXIS, -1),)
+    tp_heads_axis: Optional[str] = None  # 'model' (1D) | 'x' (2D major)
+    tp_feature_axis: Optional[str] = None  # 'y' (2D minor)
+    data_axis: str = DATA_AXIS
+    fsdp_axis: Optional[str] = None
+    expert_axis: Optional[str] = None
+    pipe_axis: Optional[str] = None
+    seq_axis: Optional[str] = None
+    # Shard the classifier head over the TP axes (vocab-parallel style).
+    # Off in every built-in preset: the head is a sliver of the FLOPs and
+    # replicated logits keep the loss/eval path collective-free.
+    shard_head: bool = False
+    fsdp_min_elements: int = 2**16
+    # Provenance: 'builtin:<name>' | 'preset:<path>' | 'mesh-axes' | None.
+    source: Optional[str] = None
+
+    def __post_init__(self):
+        axes = self.mesh_axes
+        if isinstance(axes, dict):
+            axes = tuple(axes.items())
+        else:
+            axes = tuple((str(a), int(s)) for a, s in axes)
+        object.__setattr__(self, "mesh_axes", axes)
+        names = [a for a, _ in axes]
+        if len(set(names)) != len(names):
+            raise ValueError(f"duplicate mesh axes in {names}")
+        if sum(1 for _, s in axes if s == -1) > 1:
+            raise ValueError("at most one mesh axis may be -1")
+        if self.tp_feature_axis and not self.tp_heads_axis:
+            raise ValueError(
+                "tp_feature_axis (2D minor) requires tp_heads_axis (major)"
+            )
+        for field in (
+            "tp_heads_axis", "tp_feature_axis", "fsdp_axis",
+            "expert_axis", "pipe_axis", "seq_axis",
+        ):
+            axis = getattr(self, field)
+            if axis is not None and axis not in names:
+                raise ValueError(
+                    f"{field}={axis!r} is not a mesh axis (have {names})"
+                )
+
+    # ------------------------------------------------------------- axes
+
+    def axis_dict(self) -> dict[str, int]:
+        return dict(self.mesh_axes)
+
+    def tp_degree(self) -> int:
+        """Product of the (declared, non-wildcard) TP axis sizes."""
+        sizes = self.axis_dict()
+        degree = 1
+        for axis in (self.tp_heads_axis, self.tp_feature_axis):
+            if axis is not None and sizes.get(axis, -1) != -1:
+                degree *= sizes[axis]
+        return degree
+
+    def create_mesh(self, devices=None) -> Mesh:
+        """Build the layout's mesh. A ``-1`` axis absorbs the remaining
+        devices (all of them when ``devices`` is None); a fully explicit
+        layout takes exactly the devices it sizes — a ``{"data": 1,
+        "x": 2, "y": 2}`` serving preset claims 4 chips of however many
+        the host has, instead of failing the product check."""
+        sizes = self.axis_dict()
+        if devices is None and sizes and all(s != -1 for s in sizes.values()):
+            import numpy as np
+
+            need = int(np.prod(list(sizes.values())))
+            have = jax.devices()
+            if need < len(have):
+                devices = have[:need]
+        return create_mesh(sizes, devices=devices)
+
+    def validate_against_mesh(self, mesh: Mesh) -> None:
+        """The layout's declared axes must exist on ``mesh`` with the
+        declared sizes (``-1`` matches anything). A mismatch means two
+        sources of layout truth — fail loudly."""
+        for field in (
+            "tp_heads_axis", "tp_feature_axis", "fsdp_axis",
+            "expert_axis", "pipe_axis", "seq_axis",
+        ):
+            axis = getattr(self, field)
+            if axis is not None and axis not in mesh.axis_names:
+                raise ValueError(
+                    f"layout {self.name!r} declares {field}={axis!r} but "
+                    f"the mesh has axes {mesh.axis_names}"
+                )
+        for axis, size in self.mesh_axes:
+            if axis not in mesh.axis_names:
+                raise ValueError(
+                    f"layout {self.name!r} declares mesh axis {axis!r} but "
+                    f"the mesh has {mesh.axis_names}"
+                )
+            if size != -1 and mesh.shape[axis] != size:
+                raise ValueError(
+                    f"layout {self.name!r} sizes axis {axis!r}={size} but "
+                    f"the mesh has {axis!r}={mesh.shape[axis]}"
+                )
+
+    def batch_axes(self) -> tuple[str, ...]:
+        """Mesh axes the batch dim shards over (data + fsdp when present —
+        FSDP is batch-parallel for activations)."""
+        names = [a for a, _ in self.mesh_axes]
+        return tuple(
+            a for a in (self.data_axis, self.fsdp_axis)
+            if a is not None and a in names
+        )
+
+    # ------------------------------------------------------------- specs
+
+    def batch_spec(self, dim: int = 0) -> P:
+        """Spec placing the batch axes on dimension ``dim`` (``dim=0`` is
+        the plain per-leaf batch spec; the trainer's transposed-images and
+        leading-steps placements use other dims)."""
+        return P(*([None] * dim), self.batch_axes())
+
+    def activation_spec(self) -> P:
+        """Token activations ``[B, L, D]``: batch axes on B, the 2D-TP
+        feature axis (when present) on D."""
+        return P(self.batch_axes(), None, self.tp_feature_axis)
+
+    def role_specs(self) -> dict[str, P]:
+        """The layer-role table: role -> canonical PartitionSpec.
+
+        Kernel conventions (flax): ``qkv`` is the fused 4-D
+        ``(in, 3, heads, head_ch)`` projection (the separate 3-D
+        ``to_q/k/v`` kernels drop the packing dim), ``out_proj`` is
+        ``(heads, head_ch, out)``, ``fc1``/``fc2`` are
+        ``(in, hidden)``/``(hidden, out)``, ``expert`` carries a leading
+        expert dim, ``pipe_stages`` a leading stage dim.
+        """
+        h, f = self.tp_heads_axis, self.tp_feature_axis
+        specs = {
+            "qkv": P(f, None, h, None),
+            "qkv_bias": P(None, h, None),
+            "out_proj": P(h, None, f),
+            "fc1": P(f, h),
+            "fc1_bias": P(h),
+            "fc2": P(h, f),
+            "embed": P(),
+            "norm": P(),
+            "head": P(f, h) if (self.shard_head and h) else P(),
+            "expert": (
+                P(self.expert_axis, None, None) if self.expert_axis else P()
+            ),
+            "pipe_stages": P(self.pipe_axis) if self.pipe_axis else P(),
+            "activation": self.activation_spec(),
+            "batch": self.batch_spec(),
+        }
+        if h is None:
+            for role in ("qkv", "qkv_bias", "out_proj", "fc1", "fc1_bias",
+                         "fc2"):
+                specs[role] = P()
+        return specs
+
+    def param_rules(self) -> list[tuple[str, P]]:
+        """The (path-regex, spec) rule list this layout implies — the one
+        ``sharding.DEFAULT_*_RULES`` are now derived from. Every spec is
+        read out of :meth:`role_specs` (ONE table; the separate
+        ``to_q/k/v`` kernels and the biases are positional projections of
+        the fused-qkv role, not hand-written duplicates). Pipe first (the
+        stage-axis placement must win over suffix rules), then expert,
+        then TP."""
+        roles = self.role_specs()
+        rules: list[tuple[str, P]] = []
+        if self.pipe_axis:
+            rules.append((r"pipe_stages/", roles["pipe_stages"]))
+        if self.expert_axis:
+            expert = roles["expert"]
+            rules += [
+                (r"experts_(w1|w2)$", expert),
+                (r"experts_(b1|b2)$", P(*list(expert)[:2])),
+            ]
+        if self.tp_heads_axis:
+            qkv = roles["qkv"]              # (in, 3, heads, head_ch)
+            qkv_sep = P(qkv[0], qkv[2], qkv[3])  # drop the packing dim
+            qkv_bias = roles["qkv_bias"]    # (3, heads, head_ch)
+            sep_bias = P(qkv_bias[1], qkv_bias[2])
+            rules += [
+                (r"to_qkv/kernel$", qkv),
+                (r"to_qkv/bias$", qkv_bias),
+                (r"to_q/kernel$", qkv_sep),
+                (r"to_k/kernel$", qkv_sep),
+                (r"to_v/kernel$", qkv_sep),
+                (r"to_(q|k|v)/bias$", sep_bias),
+                (r"to_out/kernel$", roles["out_proj"]),
+                (r"(fc1|expand)/kernel$", roles["fc1"]),
+                (r"(fc1|expand)/bias$", roles["fc1_bias"]),
+                (r"(fc2|project)/kernel$", roles["fc2"]),
+            ]
+            if self.shard_head:
+                head = roles["head"]
+                rules += [
+                    (r"head/kernel$", head),
+                    (r"head/bias$", P(head[1])),
+                ]
+        return rules
+
+    def param_specs(self, params: Any, *, mesh: Optional[Mesh] = None) -> Any:
+        """Tree of ``PartitionSpec`` for ``params`` (rules + FSDP
+        augmentation; no mesh required when the layout sizes its axes
+        explicitly — a wildcard ``-1`` fsdp axis resolves against
+        ``mesh`` when given, and falls through un-augmented otherwise)."""
+        specs = specs_from_rules(params, self.param_rules())
+        if self.fsdp_axis is None:
+            return specs
+        sizes = self.axis_dict()
+        fsdp_size = sizes.get(self.fsdp_axis, -1)
+        if fsdp_size == -1 and mesh is not None and (
+            self.fsdp_axis in mesh.axis_names
+        ):
+            # A -1 fsdp axis means "the remaining devices" — the mesh
+            # knows how many that is. Skipping augmentation here would
+            # silently replicate every parameter, the exact failure the
+            # warn-once fallback exists to surface.
+            fsdp_size = int(mesh.shape[self.fsdp_axis])
+        if fsdp_size in (-1, 0, 1):
+            return specs
+        flat = jax.tree_util.tree_flatten_with_path(params)[0]
+        spec_leaves = jax.tree.leaves(specs, is_leaf=lambda x: isinstance(x, P))
+        new_leaves = [
+            add_fsdp_axis(
+                s,
+                leaf.shape,
+                fsdp_size,
+                min_elements=self.fsdp_min_elements,
+                axis=self.fsdp_axis,
+                path="/".join(
+                    str(getattr(k, "key", k)) for k in path
+                ),
+            )
+            for s, (path, leaf) in zip(spec_leaves, flat)
+        ]
+        treedef = jax.tree_util.tree_structure(
+            specs, is_leaf=lambda x: isinstance(x, P)
+        )
+        return jax.tree_util.tree_unflatten(treedef, new_leaves)
+
+    def param_spec_table(self, params: Any) -> dict[str, P]:
+        """Flattened ``path -> spec`` view of :meth:`param_specs` — the
+        golden-snapshot surface (a layout regression reads as a one-line
+        diff of this table)."""
+        specs = self.param_specs(params)
+        flat = jax.tree_util.tree_flatten_with_path(
+            specs, is_leaf=lambda x: isinstance(x, P)
+        )[0]
+        return {
+            "/".join(str(getattr(k, "key", k)) for k in path): spec
+            for path, spec in flat
+        }
+
+    def param_shardings(self, params: Any, mesh: Mesh) -> Any:
+        """Tree of ``NamedSharding`` for ``params`` on ``mesh``."""
+        self.validate_against_mesh(mesh)
+        return jax.tree.map(
+            lambda s: NamedSharding(mesh, s),
+            self.param_specs(params, mesh=mesh),
+            is_leaf=lambda x: isinstance(x, P),
+        )
+
+    # ----------------------------------------------------- serialization
+
+    def to_dict(self) -> dict:
+        return {
+            "name": self.name,
+            "mesh_axes": dict(self.mesh_axes),
+            "tp_heads_axis": self.tp_heads_axis,
+            "tp_feature_axis": self.tp_feature_axis,
+            "data_axis": self.data_axis,
+            "fsdp_axis": self.fsdp_axis,
+            "expert_axis": self.expert_axis,
+            "pipe_axis": self.pipe_axis,
+            "seq_axis": self.seq_axis,
+            "shard_head": self.shard_head,
+            "fsdp_min_elements": self.fsdp_min_elements,
+        }
+
+    @classmethod
+    def from_dict(cls, doc: dict, *, source: Optional[str] = None
+                  ) -> "SpecLayout":
+        known = {f.name for f in dataclasses.fields(cls)}
+        kwargs = {k: v for k, v in doc.items() if k in known}
+        if source is not None:
+            kwargs["source"] = source
+        return cls(**kwargs)
+
+    def to_json(self) -> str:
+        return json.dumps(self.to_dict(), indent=2)
+
+    @classmethod
+    def from_json(cls, text: str) -> "SpecLayout":
+        return cls.from_dict(json.loads(text))
+
+    def describe(self, mesh: Optional[Mesh] = None) -> dict:
+        """The ``notes.layout`` manifest stamp: name, axis sizes (resolved
+        against the mesh when given), the TP shape, and which arms are
+        on — "which layout was this run" reads from this one note."""
+        sizes = (
+            {a: int(mesh.shape[a]) for a in mesh.axis_names}
+            if mesh is not None
+            else self.axis_dict()
+        )
+        tp = None
+        if self.tp_feature_axis:
+            tp = "2d"
+        elif self.tp_heads_axis:
+            tp = "1d"
+        return {
+            "name": self.name,
+            "mesh_axes": sizes,
+            "tp": tp,
+            "tp_axes": [
+                a for a in (self.tp_heads_axis, self.tp_feature_axis)
+                if a is not None
+            ],
+            "fsdp_axis": self.fsdp_axis,
+            "expert_axis": self.expert_axis,
+            "pipe_axis": self.pipe_axis,
+            "seq_axis": self.seq_axis,
+            "shard_head": self.shard_head,
+            "source": self.source,
+        }
+
+
+# ---------------------------------------------------------------- binding
+
+
+class BoundLayout:
+    """A :class:`SpecLayout` bound to a concrete mesh: the object the
+    trainer/engine hand around, turning declarative specs into
+    ``NamedSharding`` placements and activation constraints."""
+
+    def __init__(self, layout: SpecLayout, mesh: Mesh):
+        layout.validate_against_mesh(mesh)
+        self.layout = layout
+        self.mesh = mesh
+
+    def batch_sharding(self, dim: int = 0) -> NamedSharding:
+        return NamedSharding(self.mesh, self.layout.batch_spec(dim))
+
+    def replicated(self) -> NamedSharding:
+        return NamedSharding(self.mesh, P())
+
+    def activation_sharding(self) -> NamedSharding:
+        return NamedSharding(self.mesh, self.layout.activation_spec())
+
+    def param_shardings(self, tree: Any) -> Any:
+        return self.layout.param_shardings(tree, self.mesh)
+
+    def constrain_tokens(self, x):
+        """Pin token activations ``[B, L, D]`` to the layout's activation
+        spec (a ``with_sharding_constraint``). A no-op unless the layout
+        declares a 2D-TP feature axis — 1D TP propagates fine from the
+        param specs alone — or the input is not a token tensor."""
+        if self.layout.tp_feature_axis is None or x.ndim != 3:
+            return x
+        return jax.lax.with_sharding_constraint(
+            x, self.activation_sharding()
+        )
+
+
+def constrain_tokens(x, layout: Optional[BoundLayout]):
+    """Module-side seam: apply ``layout.constrain_tokens`` when a bound
+    layout was threaded in (``create_model(..., layout=...)``), identity
+    otherwise."""
+    if layout is None:
+        return x
+    return layout.constrain_tokens(x)
+
+
+# ----------------------------------------------------------- construction
+
+
+def layout_from_mesh_axes(
+    axes: Optional[dict], *, name: Optional[str] = None
+) -> SpecLayout:
+    """Infer the layout a mesh-axes dict implies — the back-compat bridge
+    for configs that state ``mesh_axes`` instead of a layout: ``model`` →
+    1D TP, ``x``/``y`` → 2D TP, ``fsdp``/``expert``/``pipe``/``seq`` by
+    presence. This is exactly the rule-selection logic
+    ``sharding.param_shardings`` applied before layouts existed."""
+    axes = dict(axes) if axes else {DATA_AXIS: -1}
+    if TP_X_AXIS in axes:
+        heads, feature = TP_X_AXIS, (TP_Y_AXIS if TP_Y_AXIS in axes else None)
+    elif MODEL_AXIS in axes:
+        heads, feature = MODEL_AXIS, None
+    else:
+        heads, feature = None, None
+    if name is None:
+        arms = [
+            a for a in (
+                "2d" if feature else ("tp" if heads else None),
+                "fsdp" if FSDP_AXIS in axes else None,
+                "expert" if EXPERT_AXIS in axes else None,
+                "pipe" if PIPE_AXIS in axes else None,
+                "seq" if SEQ_AXIS in axes else None,
+            ) if a
+        ]
+        name = "+".join(arms) if arms else "dp"
+    return SpecLayout(
+        name=name,
+        mesh_axes=tuple(axes.items()),
+        tp_heads_axis=heads,
+        tp_feature_axis=feature,
+        fsdp_axis=FSDP_AXIS if FSDP_AXIS in axes else None,
+        expert_axis=EXPERT_AXIS if EXPERT_AXIS in axes else None,
+        pipe_axis=PIPE_AXIS if PIPE_AXIS in axes else None,
+        seq_axis=SEQ_AXIS if SEQ_AXIS in axes else None,
+        source="mesh-axes",
+    )
+
+
+def layout_from_mesh(mesh: Mesh, *, name: Optional[str] = None) -> SpecLayout:
+    return layout_from_mesh_axes(
+        {a: int(mesh.shape[a]) for a in mesh.axis_names}, name=name
+    )
+
+
+def builtin_layout(name: str) -> SpecLayout:
+    """Named built-ins: ``dp`` | ``tp<N>`` | ``fsdp<N>`` | ``2d<X>x<Y>``
+    (the remaining devices always land on the data axis)."""
+    m = _BUILTIN_NAME.match(name)
+    if not m:
+        raise ValueError(
+            f"unknown layout {name!r}; built-ins are 'dp', 'tpN', 'fsdpN', "
+            "'2dXxY' (e.g. tp2, fsdp4, 2d2x2) or a preset JSON path"
+        )
+    axes: dict[str, int] = {DATA_AXIS: -1}
+    if m.group("tp"):
+        axes[MODEL_AXIS] = int(m.group("tp"))
+    elif m.group("fsdp"):
+        axes[FSDP_AXIS] = int(m.group("fsdp"))
+    elif m.group("x"):
+        axes[TP_X_AXIS] = int(m.group("x"))
+        axes[TP_Y_AXIS] = int(m.group("y"))
+    layout = layout_from_mesh_axes(axes, name=name)
+    return dataclasses.replace(layout, source=f"builtin:{name}")
+
+
+# ------------------------------------------------------------ preset files
+
+PRESET_SCHEMA = 1
+
+
+def save_layout_preset(
+    path: str,
+    layout: SpecLayout,
+    *,
+    grad_accum_steps: Optional[int] = None,
+    provenance: Optional[dict] = None,
+) -> dict:
+    """Write a layout preset (the ``tools/mesh_tune.py`` output format;
+    ``train.py --layout-preset`` / ``ServeConfig.layout_preset`` consume
+    it). Atomic tmp+replace like every other artifact writer."""
+    doc = {
+        "schema": PRESET_SCHEMA,
+        "kind": "layout-preset",
+        "layout": layout.to_dict(),
+    }
+    if grad_accum_steps is not None:
+        doc["grad_accum_steps"] = int(grad_accum_steps)
+    if provenance:
+        doc["provenance"] = provenance
+    os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
+    tmp = f"{path}.tmp.{os.getpid()}"
+    with open(tmp, "w") as f:
+        json.dump(doc, f, indent=2, default=str)
+    os.replace(tmp, path)
+    return doc
+
+
+def load_layout_preset(path: str) -> tuple[SpecLayout, dict]:
+    """Read a preset file -> (layout, full doc). Accepts both the preset
+    wrapper and a bare layout dict (hand-written presets)."""
+    with open(path) as f:
+        doc = json.load(f)
+    if not isinstance(doc, dict):
+        raise ValueError(f"{path}: layout preset must be a JSON object")
+    body = doc.get("layout", doc)
+    layout = SpecLayout.from_dict(body, source=f"preset:{path}")
+    return layout, (doc if "layout" in doc else {"layout": body})
+
+
+def resolve_layout(spec) -> Optional[SpecLayout]:
+    """One resolver for every layout-accepting surface.
+
+    ``None`` → None (caller falls back to mesh-axes inference);
+    :class:`SpecLayout` → itself; dict → :meth:`SpecLayout.from_dict`;
+    str → a preset path when it looks like one (contains a separator,
+    ends in ``.json``, or exists on disk), else a built-in name.
+    """
+    if spec is None:
+        return None
+    if isinstance(spec, SpecLayout):
+        return spec
+    if isinstance(spec, dict):
+        return SpecLayout.from_dict(spec)
+    if isinstance(spec, str):
+        if os.sep in spec or spec.endswith(".json") or os.path.exists(spec):
+            return load_layout_preset(spec)[0]
+        return builtin_layout(spec)
+    raise TypeError(f"cannot resolve a layout from {type(spec).__name__}")

@@ -110,5 +110,15 @@ def batch_sharding(mesh: Mesh) -> NamedSharding:
     return NamedSharding(mesh, P(batch_axes(mesh)))
 
 
+def batch_sharding_at(mesh: Mesh, dim: int) -> NamedSharding:
+    """Batch axes on dimension ``dim`` instead of the leading one — the
+    trainer's transposed-images (HWCN: batch last) and fused-multi-step
+    (leading ``[K, ...]`` steps axis: batch second) placements. Specs are
+    prefixes, so the result applies to any leaf with ndim > ``dim``."""
+    if dim < 0:
+        raise ValueError(f"dim must be non-negative, got {dim}")
+    return NamedSharding(mesh, P(*([None] * dim), batch_axes(mesh)))
+
+
 def replicated(mesh: Mesh) -> NamedSharding:
     return NamedSharding(mesh, P())

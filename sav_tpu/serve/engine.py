@@ -52,7 +52,6 @@ from typing import Any, Callable, Optional
 import jax
 import jax.numpy as jnp
 import numpy as np
-from jax.sharding import NamedSharding, PartitionSpec as P
 
 from sav_tpu.serve.batcher import (
     DynamicBatcher,
@@ -94,6 +93,14 @@ class ServeConfig:
     # Training checkpoint to serve (params-only restore; opt_state is
     # never materialized). None = fresh init (benches, smoke tests).
     checkpoint_dir: Optional[str] = None
+    # Declarative sharding layout (sav_tpu/parallel/layout.py): a
+    # built-in name ('tpN' | '2dXxY' | ...) or a tools/mesh_tune.py
+    # preset path. The engine then builds its mesh from the layout and
+    # SHARDS THE SERVING PARAMS by the layout's specs — one big model
+    # spans chips via TP instead of replicating (the ROADMAP item-3
+    # prerequisite). None keeps the single-device default (replicate
+    # engines for more chips).
+    layout_preset: Optional[str] = None
     # Persistent XLA compile cache: a warm second start compiles nothing
     # from scratch (startup_report["compiled_from_scratch"] == 0).
     compilation_cache_dir: Optional[str] = None
@@ -237,14 +244,53 @@ class ServeEngine:
             from sav_tpu.ops.attn_tuning import set_cache_path
 
             set_cache_path(config.attention_tune_cache)
-        if mesh is None:
-            # Serving default: one device per engine (replicate engines
-            # for more chips). A multi-device mesh is accepted when every
-            # bucket divides its batch axes (validated below).
-            from sav_tpu.parallel.mesh import create_mesh
+        from sav_tpu.parallel.layout import (
+            BoundLayout,
+            layout_from_mesh,
+            resolve_layout,
+        )
 
-            mesh = create_mesh({"data": 1}, devices=jax.devices()[:1])
+        explicit_layout = resolve_layout(config.layout_preset)
+        if explicit_layout is not None and -1 in dict(
+            explicit_layout.mesh_axes
+        ).values():
+            # Serving pins wildcard axes to 1: a built-in name like
+            # 'tp2' carries data=-1, and absorbing the host's spare
+            # chips onto the data axis would both break the bucket
+            # ladder's shard-divisibility (bucket 1 % data) and
+            # contradict the serving default — one engine claims
+            # exactly the chips its TP degree needs, replicate engines
+            # for more. A preset that WANTS a data axis sizes it
+            # explicitly.
+            import dataclasses as _dc
+
+            explicit_layout = _dc.replace(
+                explicit_layout,
+                mesh_axes=tuple(
+                    (a, 1 if s == -1 else s)
+                    for a, s in explicit_layout.mesh_axes
+                ),
+            )
+        if mesh is None:
+            if explicit_layout is not None:
+                # Layout-stated mesh over exactly the chips it sizes: a
+                # TP/2D layout spans chips with sharded params instead
+                # of replicating.
+                mesh = explicit_layout.create_mesh()
+            else:
+                # Serving default: one device per engine (replicate
+                # engines for more chips). A multi-device mesh is
+                # accepted when every bucket divides its batch axes
+                # (validated below).
+                from sav_tpu.parallel.mesh import create_mesh
+
+                mesh = create_mesh({"data": 1}, devices=jax.devices()[:1])
         self.mesh = mesh
+        self.layout = (
+            explicit_layout if explicit_layout is not None
+            else layout_from_mesh(mesh)
+        )
+        self._blayout = BoundLayout(self.layout, mesh)
         from sav_tpu.parallel.mesh import batch_axes
 
         baxes = batch_axes(mesh)
@@ -257,7 +303,7 @@ class ServeEngine:
                 "bucket must shard evenly — adjust the ladder or serve "
                 "on a single-device mesh"
             )
-        self._batch_sharding = NamedSharding(mesh, P(baxes))
+        self._batch_sharding = self._blayout.batch_sharding()
         self.compute_dtype = (
             jnp.bfloat16 if config.compute_dtype == "bfloat16" else jnp.float32
         )
@@ -270,6 +316,11 @@ class ServeEngine:
                 num_classes=config.num_classes,
                 dtype=self.compute_dtype,
                 backend=config.attention_backend,
+                # 2D-TP layouts pin between-block activations (the same
+                # seam the trainer threads; 1D propagates from params).
+                layout=(
+                    self._blayout if self.layout.tp_feature_axis else None
+                ),
                 **(config.model_overrides or {}),
             )
         self.model = model
@@ -314,6 +365,7 @@ class ServeEngine:
         )
         self.startup_report = {
             "model": config.model_name,
+            "layout": self.layout.name,
             "buckets": list(self.ladder.buckets),
             "params_source": params_source,
             "startup_s": round(time.perf_counter() - t0, 3),
@@ -348,6 +400,9 @@ class ServeEngine:
             self.manifest.begin()
         if self.manifest is not None:
             self.manifest.note("serve_startup", self.startup_report)
+            # Same provenance note the trainer stamps: "which layout was
+            # this serving" reads from notes.layout alone.
+            self.manifest.note("layout", self.layout.describe(self.mesh))
         # ---- telemetry: spans + live windows + heartbeats + SLO --------
         self._telemetry: Optional[ServeTelemetry] = None
         self._watermark = None
@@ -424,14 +479,19 @@ class ServeEngine:
 
     def _load_params(self, params, batch_stats) -> tuple:
         """(params, batch_stats, source): passed-in, params-only
-        checkpoint restore, or fresh init — replicated over the mesh."""
-        replicated = NamedSharding(self.mesh, P())
+        checkpoint restore, or fresh init — placed by the layout's param
+        specs (replicated under the default DP layout; TP/2D layouts
+        shard the serving weights over the mesh)."""
         if params is not None:
-            place = lambda tree: jax.tree.map(  # noqa: E731
-                lambda x: jax.device_put(x, replicated), tree
-            )
+            def place(tree):
+                if not tree:
+                    return tree
+                return jax.tree.map(
+                    jax.device_put, tree, self._blayout.param_shardings(tree)
+                )
+
             return place(params), place(batch_stats or {}), "passed"
-        abstract = self._abstract_state(replicated)
+        abstract = self._abstract_state()
         if self.config.checkpoint_dir:
             from sav_tpu.train.checkpoint import Checkpointer
 
@@ -450,7 +510,8 @@ class ServeEngine:
                 restored.get("batch_stats") or {},
                 f"checkpoint:{self.config.checkpoint_dir}",
             )
-        # Fresh init (benches/smoke): jitted, materialized on the mesh.
+        # Fresh init (benches/smoke): jitted, materialized on the mesh
+        # directly under the layout's shardings.
         rng = jax.random.PRNGKey(self.config.seed)
         s = self.config.image_size
 
@@ -464,16 +525,17 @@ class ServeEngine:
                 "batch_stats": variables.pop("batch_stats", {}),
             }
 
-        out_shardings = jax.tree.map(
-            lambda _: replicated, jax.eval_shape(init_fn, rng)
+        out_shardings = self._blayout.param_shardings(
+            jax.eval_shape(init_fn, rng)
         )
         built = jax.jit(init_fn, out_shardings=out_shardings)(rng)
         return built["params"], built["batch_stats"], "init"
 
-    def _abstract_state(self, sharding) -> dict:
+    def _abstract_state(self) -> dict:
         """Abstract ``{"params", "batch_stats", "step"}`` template for the
         params-only restore (shapes from a traced init — no weights are
-        materialized to build it)."""
+        materialized to build it), each leaf carrying its layout
+        sharding so the restore materializes sharded."""
         rng = jax.random.PRNGKey(0)
         s = self.config.image_size
 
@@ -487,11 +549,13 @@ class ServeEngine:
             "batch_stats": shapes.get("batch_stats", {}),
             "step": jax.ShapeDtypeStruct((), jnp.int32),
         }
+        shardings = self._blayout.param_shardings(template)
         return jax.tree.map(
-            lambda sds: jax.ShapeDtypeStruct(
-                sds.shape, sds.dtype, sharding=sharding
+            lambda sds, sh: jax.ShapeDtypeStruct(
+                sds.shape, sds.dtype, sharding=sh
             ),
             template,
+            shardings,
         )
 
     def _abstract_batch(self, bucket: int) -> dict:

@@ -112,6 +112,14 @@ class TrainConfig:
 
     # Mesh: axis name -> size (-1 absorbs remaining devices)
     mesh_axes: Optional[dict] = None
+    # Declarative sharding layout (sav_tpu/parallel/layout.py;
+    # docs/parallelism.md): a built-in name ('dp' | 'tpN' | 'fsdpN' |
+    # '2dXxY') or the path of a preset JSON emitted by
+    # tools/mesh_tune.py. States the mesh AND every param/activation
+    # spec in one object; mutually exclusive with mesh_axes (two
+    # sources of layout truth), stamped into the run manifest as
+    # notes.layout. None keeps the mesh_axes-implied layout.
+    layout_preset: Optional[str] = None
     # Sequence parallelism: 'ring' | 'ulysses' routes every self-attention
     # core through sav_tpu.parallel.seq_parallel over the mesh's 'seq'
     # axis (mesh_axes must include it; train.py --sp N builds both).

@@ -29,15 +29,18 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 import optax
-from jax.sharding import NamedSharding, PartitionSpec as P
 
 from sav_tpu.models import create_model
 from sav_tpu.obs.diagnostics import diagnostics_metrics
 from sav_tpu.obs.goodput import GoodputLedger
 from sav_tpu.obs.memory import RetraceCounter, hbm_stats
 from sav_tpu.obs.spans import SpanTracer
+from sav_tpu.parallel.layout import (
+    BoundLayout,
+    layout_from_mesh,
+    resolve_layout,
+)
 from sav_tpu.parallel.mesh import batch_axes, create_mesh
-from sav_tpu.parallel.sharding import param_shardings
 from sav_tpu.train.checkpoint import Checkpointer
 from sav_tpu.train.config import TrainConfig
 from sav_tpu.train.optimizer import (
@@ -74,6 +77,7 @@ class Trainer:
         *,
         mesh=None,
         model=None,
+        layout=None,
         checkpointer: Optional[Checkpointer] = None,
     ):
         self.config = config
@@ -91,7 +95,36 @@ class Trainer:
             from sav_tpu.ops.attn_tuning import set_cache_path
 
             set_cache_path(config.attention_tune_cache)
-        self.mesh = mesh if mesh is not None else create_mesh(config.mesh_axes)
+        # Declarative layout (sav_tpu/parallel/layout.py): an explicit
+        # layout object or config.layout_preset states the mesh AND every
+        # param/activation spec; otherwise the layout is inferred from
+        # mesh_axes (exactly the pre-layout rule selection, so existing
+        # configs behave identically). ONE source of truth: a preset
+        # composing with an explicit mesh_axes would be two, so it is
+        # rejected, and an explicit mesh must satisfy the layout.
+        explicit_layout = (
+            layout if layout is not None
+            else resolve_layout(config.layout_preset)
+        )
+        if explicit_layout is not None and config.mesh_axes:
+            raise ValueError(
+                "config.layout_preset / Trainer(layout=...) and "
+                "config.mesh_axes are two sources of layout truth; set "
+                "one (the layout states its own mesh axes)"
+            )
+        if mesh is not None:
+            self.mesh = mesh
+        elif explicit_layout is not None:
+            self.mesh = explicit_layout.create_mesh()
+        else:
+            self.mesh = create_mesh(config.mesh_axes)
+        self.layout = (
+            explicit_layout if explicit_layout is not None
+            else layout_from_mesh(self.mesh)
+        )
+        # Raises on axis/size mismatch between an explicit layout and an
+        # explicit mesh; binds the specs for the placements below.
+        self._blayout = BoundLayout(self.layout, self.mesh)
         self.compute_dtype = (
             jnp.bfloat16 if config.compute_dtype == "bfloat16" else jnp.float32
         )
@@ -161,6 +194,16 @@ class Trainer:
                     # block (the blocks shard_map q/k/v over its 'seq' axis).
                     seq_parallel=config.sequence_parallel,
                     seq_mesh=self.mesh if config.sequence_parallel else None,
+                    # 2D-TP layouts thread the bound layout so encoder
+                    # blocks pin activations to P(batch, None, 'y')
+                    # between blocks; 1D TP propagates from the param
+                    # specs alone, and SP's shard_map owns its own specs.
+                    layout=(
+                        self._blayout
+                        if self.layout.tp_feature_axis
+                        and not config.sequence_parallel
+                        else None
+                    ),
                     **(config.model_overrides or {}),
                 )
             )
@@ -282,7 +325,7 @@ class Trainer:
         abstract = jax.eval_shape(init_fn, rng)
         # Rules match on path *suffixes*, so optimizer-state mirrors of the
         # param tree (mu/nu) pick up the same TP shardings automatically.
-        shardings = param_shardings(abstract, self.mesh)
+        shardings = self._blayout.param_shardings(abstract)
         state = jax.jit(init_fn, out_shardings=shardings)(rng)
         return state
 
@@ -690,12 +733,14 @@ class Trainer:
     def train_many_steps(self, state: TrainState, batches: dict, rng: jax.Array):
         """Run ``K`` steps fused on-device; see ``_train_many_impl``."""
 
-        baxes = batch_axes(self.mesh)
-
         def sharding_for(key, leaf):
+            # Leading [K, ...] steps axis shifts the batch dim to 1; the
+            # HWCN transpose puts it last. Specs come from the layout
+            # (batch_sharding(dim) — savlint SAV117 keeps ad-hoc
+            # PartitionSpec construction out of this file).
             if key == "images" and self.config.transpose_images and leaf.ndim == 5:
-                return NamedSharding(self.mesh, P(None, None, None, None, baxes))
-            return NamedSharding(self.mesh, P(None, baxes))
+                return self._blayout.batch_sharding(dim=4)
+            return self._blayout.batch_sharding(dim=1)
 
         placed = {k: jax.device_put(v, sharding_for(k, v)) for k, v in batches.items()}
         return self._train_many(state, placed, rng)
@@ -751,13 +796,12 @@ class Trainer:
         host's data.
         """
 
-        baxes = batch_axes(self.mesh)
         multiprocess = jax.process_count() > 1
 
         def sharding_for(key, leaf):
             if key == "images" and self.config.transpose_images and leaf.ndim == 4:
-                return NamedSharding(self.mesh, P(None, None, None, baxes))
-            return NamedSharding(self.mesh, P(baxes))
+                return self._blayout.batch_sharding(dim=3)
+            return self._blayout.batch_sharding()
 
         def place(key, leaf):
             sharding = sharding_for(key, leaf)
@@ -1238,6 +1282,9 @@ class Trainer:
                 "n_devices": len(jax.devices()),
                 "process_count": jax.process_count(),
             })
+            # Layout provenance: "which layout was this run" reads from
+            # this one note (rendered by run_report/fleet_status).
+            manifest.note("layout", self.layout.describe(self.mesh))
             manifest.note(
                 "cost_model", _cost_note(cost, peak_flops, peak_source)
             )

@@ -1,0 +1,18 @@
+"""Known-bad fixture for SAV117: ad-hoc PartitionSpec/NamedSharding
+construction outside sav_tpu/parallel/ — an inline param spec, a batch
+placement built from scratch, and the fully-qualified module spelling.
+Each forks the SpecLayout source of truth."""
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+
+def shard_my_params(mesh, params):
+    spec = P(None, "model")
+    return NamedSharding(mesh, spec)
+
+
+def place_batch(mesh, batch):
+    import jax
+    import jax.sharding as jsh
+
+    sharding = jsh.NamedSharding(mesh, jsh.PartitionSpec("data"))
+    return jax.device_put(batch, sharding)

@@ -1,0 +1,28 @@
+"""Known-clean fixture for SAV117: spec construction INSIDE
+sav_tpu/parallel/ (this file's fixture-relative path) is the layout
+subsystem's job — plus the consumer idioms that are legal anywhere:
+deriving shardings from the layout/mesh helpers without ever naming
+PartitionSpec."""
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from sav_tpu.parallel import batch_sharding, batch_sharding_at, replicated
+
+
+def role_spec(heads_axis):
+    # The layout module states specs — that is its purpose.
+    return P(None, None, heads_axis, None)
+
+
+def param_sharding(mesh, heads_axis):
+    return NamedSharding(mesh, role_spec(heads_axis))
+
+
+def place_batch(mesh, trainer_layout, batch):
+    # Consumer idiom: helpers, not constructors (legal outside too).
+    import jax
+
+    sh = batch_sharding(mesh)
+    transposed = batch_sharding_at(mesh, 3)
+    rep = replicated(mesh)
+    del transposed, rep
+    return jax.device_put(batch, sh)

@@ -1,0 +1,584 @@
+"""SpecLayout tests: golden param→spec snapshots per model family,
+preset round-trips, the FSDP divisibility/warn-once contract, the
+derived-rules pins, and the layout-preset end-to-end paths.
+
+The golden tables live in ``tests/layout_goldens/<family>.json`` — the
+full flattened param→spec table of a tiny member of each model family
+under the reference layout, so ANY layout regression (a rule reordered,
+a role spec changed, the FSDP augmentation drifting) reads as a one-line
+diff of one checked-in file. Regenerate deliberately with::
+
+    python tests/test_layout.py --regen
+"""
+
+import dataclasses
+import json
+import os
+import warnings
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from sav_tpu.models import create_model
+from sav_tpu.parallel.layout import (
+    SpecLayout,
+    _spec_to_jsonable,
+    add_fsdp_axis,
+    builtin_layout,
+    layout_from_mesh_axes,
+    load_layout_preset,
+    reset_fsdp_fallback_warnings,
+    resolve_layout,
+    save_layout_preset,
+)
+
+GOLDENS_DIR = os.path.join(os.path.dirname(__file__), "layout_goldens")
+
+# The reference layout every family snapshots under: 1D TP over 'model'
+# composed with FSDP — together they exercise every rule family plus the
+# divisibility-aware augmentation. Small min_elements so the tiny test
+# models still get FSDP-sharded leaves.
+REF_LAYOUT = SpecLayout(
+    name="golden-ref",
+    mesh_axes=(("data", 2), ("model", 2), ("fsdp", 2)),
+    tp_heads_axis="model",
+    fsdp_axis="fsdp",
+    fsdp_min_elements=2**12,
+)
+
+# One tiny member per model family (the test_models.py shapes).
+FAMILIES = {
+    "vit": ("vit_ti_patch16", 32, dict(num_layers=2, embed_dim=64, num_heads=4)),
+    "moe": (
+        "vit_moe_s_patch16_e8", 32,
+        dict(num_layers=2, embed_dim=64, num_heads=4),
+    ),
+    "cait": (
+        "cait_xxs_24", 32,
+        dict(
+            num_layers=2, num_layers_token_only=2, embed_dim=64, num_heads=4,
+            patch_shape=(8, 8),
+        ),
+    ),
+    "tnt": (
+        "tnt_s_patch16", 32,
+        dict(
+            num_layers=2, embed_dim=64, inner_ch=24, num_heads=4,
+            inner_num_heads=4, patch_shape=(16, 16),
+        ),
+    ),
+    "ceit": (
+        "ceit_t", 32,
+        dict(num_layers=2, embed_dim=64, num_heads=4, patch_shape=(4, 4)),
+    ),
+    "cvt": (
+        "cvt-13", 32,
+        dict(embed_dims=(32, 64, 128), num_layers=(1, 1, 2), num_heads=(1, 2, 4)),
+    ),
+    "botnet": ("botnet_t3", 64, dict(stage_sizes=(1, 1, 1, 1))),
+    "mixer": (
+        "mixer_s_patch32", 32,
+        dict(
+            num_layers=2, embed_dim=64, tokens_hidden_ch=32,
+            channels_hidden_ch=128, patch_shape=(8, 8),
+        ),
+    ),
+}
+
+
+def _abstract_params(model_name: str, image_size: int, overrides: dict):
+    model = create_model(model_name, num_classes=10, **overrides)
+    rngs = {
+        "params": jax.random.PRNGKey(0),
+        "dropout": jax.random.PRNGKey(1),
+        "stochastic_depth": jax.random.PRNGKey(2),
+    }
+    variables = jax.eval_shape(
+        lambda x: model.init(rngs, x, is_training=False),
+        jax.ShapeDtypeStruct((1, image_size, image_size, 3), jnp.float32),
+    )
+    return variables["params"]
+
+
+def _golden_table(family: str) -> dict:
+    model_name, image_size, overrides = FAMILIES[family]
+    params = _abstract_params(model_name, image_size, overrides)
+    table = REF_LAYOUT.param_spec_table(params)
+    return {path: _spec_to_jsonable(spec) for path, spec in table.items()}
+
+
+@pytest.mark.parametrize("family", sorted(FAMILIES))
+def test_golden_layout_snapshot(family):
+    """The full param→spec table under the reference layout matches the
+    checked-in golden — a layout regression reads as a one-line diff."""
+    path = os.path.join(GOLDENS_DIR, f"{family}.json")
+    assert os.path.exists(path), (
+        f"missing golden {path}; generate with "
+        "`python tests/test_layout.py --regen` and review the diff"
+    )
+    with open(path) as f:
+        golden = json.load(f)
+    actual = _golden_table(family)
+    if actual != golden:
+        lines = []
+        for key in sorted(set(golden) | set(actual)):
+            g, a = golden.get(key), actual.get(key)
+            if g != a:
+                lines.append(f"  {key}: golden={g} actual={a}")
+        raise AssertionError(
+            f"layout snapshot drift for {family!r} "
+            f"({len(lines)} param(s)):\n" + "\n".join(lines[:20])
+            + ("\n  ..." if len(lines) > 20 else "")
+            + "\nIf intentional, regenerate: python tests/test_layout.py --regen"
+        )
+
+
+def test_goldens_cover_sharded_and_replicated_leaves():
+    """The reference snapshot is non-trivial: TP-sharded, FSDP-sharded,
+    and replicated leaves all appear (a golden of all-P() would pin
+    nothing)."""
+    table = _golden_table("vit")
+    flat = set(map(tuple, (tuple(map(str, v)) for v in table.values())))
+    assert any("model" in t for t in flat), "no TP-sharded leaf in golden"
+    assert any("fsdp" in t for t in flat), "no FSDP-sharded leaf in golden"
+    assert [] in list(table.values()), "no replicated leaf in golden"
+
+
+# ------------------------------------------------------------ round-trips
+
+
+@pytest.mark.parametrize(
+    "layout",
+    [
+        builtin_layout("dp"),
+        builtin_layout("tp2"),
+        builtin_layout("fsdp4"),
+        builtin_layout("2d2x4"),
+        REF_LAYOUT,
+        SpecLayout(
+            name="everything",
+            mesh_axes=(
+                ("data", -1), ("x", 2), ("y", 2), ("fsdp", 2),
+                ("expert", 2), ("pipe", 2),
+            ),
+            tp_heads_axis="x",
+            tp_feature_axis="y",
+            fsdp_axis="fsdp",
+            expert_axis="expert",
+            pipe_axis="pipe",
+            shard_head=True,
+        ),
+    ],
+    ids=lambda l: l.name,
+)
+def test_spec_layout_json_round_trip(layout):
+    back = SpecLayout.from_json(layout.to_json())
+    # source is provenance, not layout content — everything else must
+    # survive the trip bit-for-bit.
+    assert dataclasses.replace(back, source=layout.source) == layout
+    assert back.param_rules() == layout.param_rules()
+    assert back.role_specs() == layout.role_specs()
+
+
+def test_preset_file_round_trip(tmp_path):
+    path = str(tmp_path / "preset.json")
+    layout = builtin_layout("2d2x2")
+    doc = save_layout_preset(
+        path, layout, grad_accum_steps=4, provenance={"tool": "test"}
+    )
+    assert doc["schema"] == 1 and doc["kind"] == "layout-preset"
+    back, full = load_layout_preset(path)
+    assert dataclasses.replace(back, source=None) == dataclasses.replace(
+        layout, source=None
+    )
+    assert back.source == f"preset:{path}"
+    assert full["grad_accum_steps"] == 4
+    assert full["provenance"] == {"tool": "test"}
+
+
+def test_load_preset_accepts_bare_layout_dict(tmp_path):
+    path = str(tmp_path / "bare.json")
+    with open(path, "w") as f:
+        json.dump(builtin_layout("tp2").to_dict(), f)
+    back, _ = load_layout_preset(path)
+    assert back.tp_heads_axis == "model"
+    assert back.axis_dict() == {"data": -1, "model": 2}
+
+
+def test_resolve_layout_surfaces(tmp_path):
+    assert resolve_layout(None) is None
+    layout = builtin_layout("tp2")
+    assert resolve_layout(layout) is layout
+    assert resolve_layout("fsdp4").fsdp_axis == "fsdp"
+    assert resolve_layout({"name": "x", "mesh_axes": {"data": 4}}).name == "x"
+    path = str(tmp_path / "p.json")
+    save_layout_preset(path, layout)
+    assert resolve_layout(path).tp_heads_axis == "model"
+    with pytest.raises(ValueError, match="unknown layout"):
+        resolve_layout("tp2x3y")
+
+
+def test_builtin_layout_names():
+    assert builtin_layout("dp").tp_heads_axis is None
+    tp = builtin_layout("tp4")
+    assert tp.tp_heads_axis == "model" and tp.axis_dict()["model"] == 4
+    twod = builtin_layout("2d2x4")
+    assert twod.tp_heads_axis == "x" and twod.tp_feature_axis == "y"
+    assert twod.axis_dict() == {"data": -1, "x": 2, "y": 4}
+    assert twod.tp_degree() == 8
+
+
+def test_layout_validation_rejects_bad_axes():
+    with pytest.raises(ValueError, match="not a mesh axis"):
+        SpecLayout(mesh_axes=(("data", -1),), tp_heads_axis="model")
+    with pytest.raises(ValueError, match="requires tp_heads_axis"):
+        SpecLayout(
+            mesh_axes=(("data", -1), ("y", 2)), tp_feature_axis="y"
+        )
+    with pytest.raises(ValueError, match="duplicate"):
+        SpecLayout(mesh_axes=(("data", 2), ("data", 4)))
+
+
+# ------------------------------------------------- derived legacy surfaces
+
+
+def test_default_tp_rules_are_the_historical_list():
+    """The layout-derived DEFAULT_TP_RULES must stay byte-for-byte the
+    rules earlier rounds hand-wrote — existing callers and checkpoints
+    see no change."""
+    from sav_tpu.parallel.sharding import DEFAULT_TP_RULES
+
+    assert DEFAULT_TP_RULES == [
+        (r"to_qkv/kernel$", P(None, None, "model", None)),
+        (r"to_qkv/bias$", P(None, "model", None)),
+        (r"to_q/kernel$", P(None, "model", None)),
+        (r"to_k/kernel$", P(None, "model", None)),
+        (r"to_v/kernel$", P(None, "model", None)),
+        (r"to_(q|k|v)/bias$", P("model", None)),
+        (r"to_out/kernel$", P("model", None, None)),
+        (r"(fc1|expand)/kernel$", P(None, "model")),
+        (r"(fc1|expand)/bias$", P("model")),
+        (r"(fc2|project)/kernel$", P("model", None)),
+    ]
+
+
+def test_default_ep_pp_rules_are_the_historical_lists():
+    from sav_tpu.parallel.sharding import DEFAULT_EP_RULES, DEFAULT_PP_RULES
+
+    assert DEFAULT_EP_RULES == [
+        (r"experts_(w1|w2)$", P("expert", None, None)),
+        (r"experts_(b1|b2)$", P("expert", None)),
+    ]
+    assert DEFAULT_PP_RULES == [(r"pipe_stages/", P("pipe"))]
+
+
+def test_layout_from_mesh_axes_matches_legacy_selection():
+    """mesh-axes inference reproduces the pre-layout rule selection:
+    'model' → 1D TP, x/y → 2D, fsdp/expert/pipe by presence."""
+    tp = layout_from_mesh_axes({"data": 2, "model": 4})
+    assert tp.tp_heads_axis == "model" and tp.tp_feature_axis is None
+    twod = layout_from_mesh_axes({"data": 1, "x": 2, "y": 2})
+    assert (twod.tp_heads_axis, twod.tp_feature_axis) == ("x", "y")
+    fsdp = layout_from_mesh_axes({"data": 2, "fsdp": 4})
+    assert fsdp.fsdp_axis == "fsdp" and fsdp.tp_heads_axis is None
+    every = layout_from_mesh_axes(
+        {"data": 1, "model": 2, "fsdp": 2, "expert": 2, "pipe": 2, "seq": 2}
+    )
+    assert every.expert_axis == "expert"
+    assert every.pipe_axis == "pipe"
+    assert every.seq_axis == "seq"
+    assert layout_from_mesh_axes(None).axis_dict() == {"data": -1}
+
+
+# ----------------------------------------------------------- FSDP contract
+
+
+class TestFSDPDivisibility:
+    def test_largest_divisible_dim_wins_over_biggest(self):
+        # Biggest dim (10) does not divide the axis — the next divisible
+        # one (8) must be sharded, never an uneven shard or a silent
+        # replication.
+        spec = add_fsdp_axis(P(), (10, 8), 4, min_elements=0)
+        assert spec == P(None, "fsdp")
+
+    def test_already_sharded_dims_are_not_restacked(self):
+        spec = add_fsdp_axis(P("model", None), (8, 6), 2, min_elements=0)
+        assert spec == P("model", "fsdp")
+
+    def test_small_tensors_stay_replicated_silently(self):
+        reset_fsdp_fallback_warnings()
+        with warnings.catch_warnings():
+            warnings.simplefilter("error")
+            assert add_fsdp_axis(P(), (4,), 4, min_elements=2**16) == P()
+
+    def test_indivisible_fallback_warns_once_per_offender(self):
+        reset_fsdp_fallback_warnings()
+        with pytest.warns(UserWarning, match="stays REPLICATED"):
+            assert add_fsdp_axis(
+                P(), (3, 5), 4, min_elements=0, path="enc/w"
+            ) == P()
+        # Same offender again: silent (warn-once registry).
+        with warnings.catch_warnings():
+            warnings.simplefilter("error")
+            assert add_fsdp_axis(
+                P(), (3, 5), 4, min_elements=0, path="enc/w"
+            ) == P()
+        # A DIFFERENT offender still warns.
+        with pytest.warns(UserWarning, match="stays REPLICATED"):
+            add_fsdp_axis(P(), (7, 9), 4, min_elements=0, path="enc/w2")
+        reset_fsdp_fallback_warnings()
+
+    def test_fsdp_wildcard_axis_resolves_against_mesh(self, devices):
+        """A -1 fsdp axis must resolve to the mesh's actual size at
+        placement time — skipping augmentation would silently replicate
+        every parameter (the exact failure the warn-once fallback
+        exists to surface)."""
+        layout = SpecLayout(
+            name="f", mesh_axes=(("data", 2), ("fsdp", -1)),
+            fsdp_axis="fsdp", fsdp_min_elements=0,
+        )
+        mesh = layout.create_mesh()
+        assert int(mesh.shape["fsdp"]) == 4
+        params = {"big": jax.ShapeDtypeStruct((8, 16), jnp.float32)}
+        sh = layout.param_shardings(params, mesh)
+        assert sh["big"].spec == P(None, "fsdp")
+        # Without a mesh the wildcard size is unknowable — un-augmented.
+        assert layout.param_specs(params)["big"] == P()
+
+    def test_layout_param_specs_apply_fsdp_with_warning(self):
+        reset_fsdp_fallback_warnings()
+        layout = SpecLayout(
+            name="f", mesh_axes=(("data", 2), ("fsdp", 4)),
+            fsdp_axis="fsdp", fsdp_min_elements=0,
+        )
+        params = {
+            "big": jax.ShapeDtypeStruct((10, 8), jnp.float32),
+            "odd": jax.ShapeDtypeStruct((3, 5), jnp.float32),
+        }
+        with pytest.warns(UserWarning, match="stays REPLICATED"):
+            specs = layout.param_specs(params)
+        assert specs["big"] == P(None, "fsdp")
+        assert specs["odd"] == P()
+        reset_fsdp_fallback_warnings()
+
+
+# ------------------------------------------------------- e2e: train path
+
+
+def test_trainer_layout_preset_end_to_end(tmp_path, devices):
+    """A preset file drives the trainer: mesh built from the layout,
+    params sharded by its specs, provenance in layout.describe()."""
+    from sav_tpu.train import TrainConfig, Trainer
+
+    preset = str(tmp_path / "preset.json")
+    save_layout_preset(
+        preset,
+        SpecLayout(
+            name="tp2-test",
+            mesh_axes=(("data", 4), ("model", 2)),
+            tp_heads_axis="model",
+        ),
+    )
+    config = TrainConfig(
+        model_name="vit_ti_patch16",
+        num_classes=10,
+        image_size=32,
+        compute_dtype="float32",
+        global_batch_size=8,
+        num_train_images=32,
+        num_epochs=1,
+        warmup_epochs=1,
+        transpose_images=False,
+        layout_preset=preset,
+        model_overrides=dict(num_layers=2, embed_dim=64, num_heads=4),
+        seed=0,
+    )
+    trainer = Trainer(config)
+    assert trainer.layout.name == "tp2-test"
+    assert trainer.layout.source == f"preset:{preset}"
+    assert dict(trainer.mesh.shape) == {"data": 4, "model": 2}
+    state = trainer.init_state()
+    qkv = state.params["Encoder_0"]["block_0"]["SelfAttentionBlock_0"][
+        "to_qkv"
+    ]["kernel"]
+    assert qkv.sharding.spec == P(None, None, "model", None)
+    from sav_tpu.data import synthetic_data_iterator
+
+    batch = next(
+        synthetic_data_iterator(batch_size=8, image_size=32, num_classes=10)
+    )
+    state, metrics = trainer.train_step(state, batch, jax.random.PRNGKey(0))
+    assert np.isfinite(float(jax.device_get(metrics["loss"])))
+    note = trainer.layout.describe(trainer.mesh)
+    assert note["name"] == "tp2-test"
+    assert note["mesh_axes"] == {"data": 4, "model": 2}
+    assert note["tp"] == "1d"
+    assert note["source"] == f"preset:{preset}"
+
+
+def test_trainer_rejects_two_sources_of_layout_truth():
+    from sav_tpu.train import TrainConfig, Trainer
+
+    config = TrainConfig(
+        model_name="vit_ti_patch16",
+        num_classes=10,
+        image_size=32,
+        global_batch_size=8,
+        num_train_images=32,
+        layout_preset="tp2",
+        mesh_axes={"data": 8},
+    )
+    with pytest.raises(ValueError, match="two sources of layout truth"):
+        Trainer(config)
+
+
+def test_trainer_2d_layout_trains(devices):
+    """2D TP end-to-end: x,y axes, activation constraint threaded into
+    the encoder blocks, finite loss."""
+    from sav_tpu.data import synthetic_data_iterator
+    from sav_tpu.train import TrainConfig, Trainer
+
+    config = TrainConfig(
+        model_name="vit_ti_patch16",
+        num_classes=10,
+        image_size=32,
+        compute_dtype="float32",
+        global_batch_size=8,
+        num_train_images=32,
+        num_epochs=1,
+        warmup_epochs=1,
+        transpose_images=False,
+        layout_preset="2d2x2",
+        model_overrides=dict(num_layers=2, embed_dim=64, num_heads=4),
+        seed=0,
+    )
+    trainer = Trainer(config)
+    assert dict(trainer.mesh.shape) == {"data": 2, "x": 2, "y": 2}
+    assert trainer.layout.tp_feature_axis == "y"
+    state = trainer.init_state()
+    qkv = state.params["Encoder_0"]["block_0"]["SelfAttentionBlock_0"][
+        "to_qkv"
+    ]["kernel"]
+    assert qkv.sharding.spec == P("y", None, "x", None)
+    batch = next(
+        synthetic_data_iterator(batch_size=8, image_size=32, num_classes=10)
+    )
+    state, metrics = trainer.train_step(state, batch, jax.random.PRNGKey(0))
+    assert np.isfinite(float(jax.device_get(metrics["loss"])))
+
+
+# ------------------------------------------------------- e2e: serve path
+
+
+def test_serve_engine_layout_preset_shards_params(tmp_path, devices):
+    """ServeEngine under a TP layout: mesh from the layout, serving
+    params actually sharded (not replicated), layout in the startup
+    report and the manifest note."""
+    from sav_tpu.serve.engine import ServeConfig, ServeEngine
+
+    # The documented usage: a built-in name. Its data=-1 wildcard must
+    # pin to 1 for serving (claim exactly the TP degree, replicate
+    # engines for more chips) — absorbing the host's spare chips onto
+    # the data axis would break the bucket ladder's divisibility.
+    config = ServeConfig(
+        model_name="vit_ti_patch16",
+        num_classes=10,
+        image_size=32,
+        model_overrides={"num_layers": 1, "embed_dim": 64, "num_heads": 4},
+        buckets=[1, 2],
+        layout_preset="tp2",
+        deadline_ms=5000.0,
+        log_dir=str(tmp_path),
+    )
+    engine = ServeEngine(config)
+    rng = np.random.default_rng(0)
+    with engine:
+        assert dict(engine.mesh.shape) == {"data": 1, "model": 2}
+        assert engine.startup_report["layout"] == "tp2"
+        qkv = engine._params["Encoder_0"]["block_0"]["SelfAttentionBlock_0"][
+            "to_qkv"
+        ]["kernel"]
+        assert qkv.sharding.spec == P(None, None, "model", None)
+        assert not qkv.sharding.is_fully_replicated
+        img = rng.integers(0, 256, (32, 32, 3), dtype=np.uint8)
+        out = engine.submit(img).result(timeout=60.0)
+        assert out.shape == (10,) and np.isfinite(out).all()
+    manifests = [
+        f for f in os.listdir(tmp_path) if f.startswith("manifest")
+    ]
+    with open(os.path.join(tmp_path, manifests[0])) as f:
+        doc = json.load(f)
+    assert doc["notes"]["layout"]["name"] == "tp2"
+    assert doc["notes"]["layout"]["tp"] == "1d"
+
+
+# ------------------------------------------------- provenance rendering
+
+
+def test_run_report_and_fleet_status_render_layout_note(tmp_path, capsys):
+    """notes.layout reads back from one artifact: run_report's manifest
+    section and fleet_status's layout scan both render it."""
+    import importlib.util
+    import io
+    import sys as _sys
+
+    note = {
+        "name": "2d2x4",
+        "mesh_axes": {"data": 1, "x": 2, "y": 4},
+        "tp": "2d",
+        "tp_axes": ["x", "y"],
+        "fsdp_axis": None,
+        "source": "preset:/tmp/p.json",
+    }
+    manifest = {"kind": "train", "outcome": "ok", "notes": {"layout": note}}
+    with open(tmp_path / "manifest.json", "w") as f:
+        json.dump(manifest, f)
+
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+    def load_tool(name):
+        spec = importlib.util.spec_from_file_location(
+            name, os.path.join(root, "tools", f"{name}.py")
+        )
+        module = importlib.util.module_from_spec(spec)
+        _sys.modules[spec.name] = module
+        spec.loader.exec_module(module)
+        return module
+
+    run_report = load_tool("run_report")
+    out = io.StringIO()
+    run_report.report_manifest(manifest, out)
+    text = out.getvalue()
+    assert "layout: 2d2x4 [data=1 x=2 y=4]" in text
+    assert "2d tp over x+y" in text
+    assert "preset:/tmp/p.json" in text
+
+    fleet_status = load_tool("fleet_status")
+    notes = fleet_status.read_layout_notes(str(tmp_path))
+    assert notes == [{"manifest": "manifest.json", **note}]
+
+
+# ------------------------------------------------------------------ regen
+
+
+def _regen():
+    os.makedirs(GOLDENS_DIR, exist_ok=True)
+    for family in sorted(FAMILIES):
+        table = _golden_table(family)
+        path = os.path.join(GOLDENS_DIR, f"{family}.json")
+        with open(path, "w") as f:
+            json.dump(table, f, indent=1, sort_keys=True)
+            f.write("\n")
+        print(f"wrote {path} ({len(table)} params)")
+
+
+if __name__ == "__main__":
+    import sys
+
+    if "--regen" in sys.argv:
+        _regen()
+    else:
+        print(__doc__)

@@ -124,6 +124,12 @@ BAD_EXPECTATIONS = {
         ("SAV116", 22),  # float(metrics[...]) in observe_completed()
         ("SAV116", 26),  # metrics[...].item() in the heartbeat emitter
     ],
+    "sav117_bad.py": [
+        ("SAV117", 9),   # inline PartitionSpec for a param
+        ("SAV117", 10),  # inline NamedSharding
+        ("SAV117", 17),  # jsh.NamedSharding(...) — qualified spelling
+        ("SAV117", 17),  # ...wrapping a jsh.PartitionSpec(...) call
+    ],
 }
 
 CLEAN_FIXTURES = [
@@ -143,6 +149,7 @@ CLEAN_FIXTURES = [
     "sav_tpu/obs/sav114_clean.py",
     "sav115_clean.py",
     "sav116_clean.py",
+    "sav_tpu/parallel/sav117_clean.py",
 ]
 
 

@@ -102,6 +102,17 @@ def test_serve_hot_loop_suppressions_are_the_known_set():
     assert telemetry.suppressed == []
 
 
+def test_adhoc_partition_spec_suppressions_are_zero():
+    """SAV117 (adhoc-partition-spec): every PartitionSpec/NamedSharding
+    outside sav_tpu/parallel/ derives from the SpecLayout — the rule
+    carries ZERO suppressions over the whole linted surface, so the one
+    source of layout truth cannot erode one pragma at a time
+    (docs/parallelism.md)."""
+    result = lint_paths(SELF_PATHS, root=ROOT, baseline=DEFAULT_BASELINE)
+    assert [f for f in result.findings if f.rule == "SAV117"] == []
+    assert [f for f in result.suppressed if f.rule == "SAV117"] == []
+
+
 def test_library_exit_suppressions_are_the_two_contracts():
     """SAV114's sanctioned library exits stay exactly the documented
     pair (docs/elasticity.md exit-code table): the watchdog's os._exit

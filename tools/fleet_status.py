@@ -47,6 +47,21 @@ from sav_tpu.obs.fleet import (  # noqa: E402
 from sav_tpu.serve.telemetry import aggregate_serve  # noqa: E402
 
 
+def read_layout_notes(log_dir: str) -> list:
+    """Every manifest's ``notes.layout`` under the log dir — the
+    SpecLayout provenance stamp (mesh shape, axis sizes, preset source)
+    the trainer and the serve engine write, so "which layout was this
+    run" reads from the same artifact set as the heartbeats."""
+    from sav_tpu.obs.fleet import iter_manifests
+
+    layouts = []
+    for path, doc in iter_manifests(log_dir):
+        note = (doc.get("notes") or {}).get("layout")
+        if isinstance(note, dict):
+            layouts.append({"manifest": os.path.basename(path), **note})
+    return layouts
+
+
 def render(log_dir: str, summary: dict, out) -> None:
     processes = summary.get("processes") or {}
     print(f"== Fleet status: {log_dir} ==", file=out)
@@ -164,6 +179,26 @@ def render(log_dir: str, summary: dict, out) -> None:
                 + f", shed {v.get('shed')}{flame}",
                 file=out,
             )
+    layouts = read_layout_notes(log_dir)
+    if layouts:
+        print(f"Layouts: {len(layouts)} manifest(s)", file=out)
+        for note in layouts:
+            axes = note.get("mesh_axes") or {}
+            axes_s = " ".join(f"{a}={s}" for a, s in axes.items()) or "?"
+            tp = note.get("tp")
+            print(
+                f"  {note.get('manifest')}: {note.get('name', '?')} "
+                f"[{axes_s}]"
+                + (
+                    f", {tp} tp over "
+                    + "+".join(note.get("tp_axes") or []) if tp else ""
+                )
+                + (
+                    f", source {note['source']}"
+                    if note.get("source") else ""
+                ),
+                file=out,
+            )
     probes = read_probe_timeline(log_dir)
     if probes:
         attempts = [p for p in probes if p.get("kind") == "probe"]
@@ -237,6 +272,7 @@ def main(argv=None) -> int:
               file=sys.stderr)
         return 2
     summary = aggregate_fleet(args.log_dir, straggler_k=args.straggler_k)
+    summary["layouts"] = read_layout_notes(args.log_dir)
     summary["autoprof"] = autoprof_captures(args.log_dir)
     summary["probe_timeline"] = read_probe_timeline(args.log_dir)
     # Serve heartbeats (kind=serve) share the fleet/proc_*.jsonl files;

@@ -266,6 +266,30 @@ def report_manifest(doc: dict, out) -> None:
         file=out,
     )
     notes = doc.get("notes") or {}
+    layout = notes.get("layout") or {}
+    if layout:
+        # "Which layout was this run" reads from this one line
+        # (sav_tpu/parallel/layout.py SpecLayout.describe provenance).
+        axes = layout.get("mesh_axes") or {}
+        axes_s = " ".join(f"{a}={s}" for a, s in axes.items()) or "?"
+        arms = []
+        if layout.get("tp"):
+            arms.append(
+                f"{layout['tp']} tp over "
+                + "+".join(layout.get("tp_axes") or [])
+            )
+        for key in ("fsdp_axis", "expert_axis", "pipe_axis", "seq_axis"):
+            if layout.get(key):
+                arms.append(f"{key.split('_')[0]} over {layout[key]}")
+        print(
+            f"  layout: {layout.get('name', '?')} [{axes_s}]"
+            + (f" — {', '.join(arms)}" if arms else " — pure dp")
+            + (
+                f" (source {layout['source']})"
+                if layout.get("source") else ""
+            ),
+            file=out,
+        )
     if "seq_replication_fallback" in notes:
         info = notes["seq_replication_fallback"]
         print(

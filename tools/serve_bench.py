@@ -67,6 +67,7 @@ def run(args, manifest) -> dict:
         max_queue=args.max_queue,
         deadline_ms=args.deadline_ms,
         checkpoint_dir=args.checkpoint,
+        layout_preset=args.layout_preset,
         compilation_cache_dir=args.compilation_cache_dir,
         # Telemetry artifacts (serve heartbeats, slow-request exemplars,
         # anomaly captures) land next to the manifest; --no-telemetry is
@@ -142,6 +143,13 @@ def main(argv=None) -> int:
     parser.add_argument(
         "--batch-1", action="store_true",
         help="ladder [1]: the no-batching A/B baseline",
+    )
+    parser.add_argument(
+        "--layout-preset", default=None,
+        help="declarative sharding layout (built-in name or a "
+        "tools/mesh_tune.py preset path): the engine builds its mesh "
+        "from it and SHARDS the serving params by its specs — one big "
+        "model spans chips via TP (docs/parallelism.md)",
     )
     parser.add_argument("--max-queue", type=int, default=256)
     parser.add_argument("--deadline-ms", type=float, default=100.0)

@@ -389,9 +389,9 @@ def worker(rank: int, coordinator: str, mode: str) -> None:
     if mode == "fsdp":
         # The batch shards over (data, fsdp); under the transposed mesh each
         # process owns two non-contiguous quarters — place shards explicitly.
-        from jax.sharding import NamedSharding, PartitionSpec as P
+        from sav_tpu.parallel import batch_sharding
 
-        sh = NamedSharding(mesh, P(("data", "fsdp")))
+        sh = batch_sharding(mesh)
         batch = {
             "images": _make_global(images, sh),
             "labels": _make_global(labels.astype(np.int32), sh),

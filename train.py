@@ -80,6 +80,14 @@ import click
     "activation HBM — for batch/sequence sizes that otherwise OOM.",
 )
 @click.option("--dtype", type=click.Choice(["bfloat16", "float32"]), default="bfloat16")
+@click.option(
+    "--layout-preset", type=str, default=None,
+    help="Declarative sharding layout (sav_tpu/parallel/layout.py): a "
+    "built-in name ('dp' | 'tpN' | 'fsdpN' | '2dXxY') or the path of a "
+    "preset JSON emitted by tools/mesh_tune.py. States the mesh AND "
+    "every param/activation spec in one object; mutually exclusive with "
+    "--tp/--fsdp/--sp/--pp. Stamped into the manifest as notes.layout.",
+)
 @click.option("--tp", type=int, default=1, help="Tensor-parallel mesh axis size.")
 @click.option("--fsdp", type=int, default=1, help="FSDP mesh axis size (params sharded).")
 @click.option(
@@ -468,7 +476,8 @@ def _run(
     num_epochs, warmup_epochs, learning_rate, weight_decay, label_smoothing,
     ema_decay, clip_grad, grad_accum, augmentation, patch_size, backend,
     attn_tune_cache, logits_dtype,
-    remat, dtype, tp, fsdp, sp, sp_method, pp, pp_microbatches, preset,
+    remat, dtype, layout_preset, tp, fsdp, sp, sp_method, pp,
+    pp_microbatches, preset,
     checkpoint_dir, checkpoint_every_steps, checkpoint_every_secs,
     supervise, max_restarts, restart_backoff, skip_steps, synth_data,
     debug_nans, init_from,
@@ -559,6 +568,31 @@ def _run(
         from sav_tpu.data.pipeline import Split, load
 
     mesh_axes = None
+    if layout_preset and (tp > 1 or fsdp > 1 or sp > 1 or pp > 1):
+        # Two sources of layout truth: the preset states its own mesh
+        # axes, the per-arm flags would state another.
+        raise click.UsageError(
+            "--layout-preset states the whole layout (mesh axes included); "
+            "drop --tp/--fsdp/--sp/--pp"
+        )
+    if (
+        layout_preset
+        and os.path.exists(layout_preset)
+        and ctx.get_parameter_source("grad_accum")
+        != click.core.ParameterSource.COMMANDLINE
+    ):
+        # A mesh_tune preset decides the microbatch too: its
+        # grad_accum_steps rides along unless --grad-accum was passed
+        # EXPLICITLY (an explicit `--grad-accum 1` must win — the A/B
+        # against accumulation — so the check is on the parameter
+        # source, not the value).
+        from sav_tpu.parallel.layout import load_layout_preset
+
+        preset_accum = load_layout_preset(layout_preset)[1].get(
+            "grad_accum_steps"
+        )
+        if preset_accum:
+            grad_accum = int(preset_accum)
     if pp > 1 and (tp > 1 or fsdp > 1 or sp > 1):
         raise click.UsageError(
             "--pp composes with data parallelism only; drop --tp/--fsdp/--sp"
@@ -611,6 +645,7 @@ def _run(
         compilation_cache_dir=compilation_cache_dir,
         peak_flops=peak_flops,
         mesh_axes=mesh_axes,
+        layout_preset=layout_preset,
         sequence_parallel=sp_method if sp > 1 else None,
         pipeline_parallel=pp if pp > 1 else None,
         pipeline_microbatches=pp_microbatches,
@@ -675,6 +710,7 @@ def _run(
             "record_batches": "record_batches",
             "spike_sigma": "spike_sigma",
             "sanitize": "sanitize",
+            "layout_preset": "layout_preset",
         }
         overrides = {
             field: getattr(config, field)

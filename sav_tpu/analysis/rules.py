@@ -1235,6 +1235,57 @@ class ServeTelemetryHotPathSync(Rule):
                 )
 
 
+# ---------------------------------------------------------------- SAV118
+
+
+class RouterHotPathSync(Rule):
+    """Host sync in the fleet router's admit/route/drain path.
+
+    The fleet router (sav_tpu/serve/router.py, docs/serving.md "Fleet")
+    is the one component EVERY request in the fleet passes through: its
+    admission projection, replica choice, completion bookkeeping, and
+    view refresh run on the submit path or the dispatch workers, and
+    every value they touch is host-side by construction — parsed
+    heartbeat JSON, wall clocks, the router's own counters (the module
+    is stdlib-only; jax is structurally unimportable from it). A
+    ``device_get`` / ``block_until_ready`` / ``.item()`` slipped into
+    ``admit()`` / ``route()`` / ``note_result()`` / ``_refresh_views()``
+    / ``drain()`` / ``resume()``, or a ``float(metrics...)`` pulling a
+    device scalar through ``__float__``, would serialize every request
+    in the FLEET behind one pipeline drain — the whole-fleet version of
+    the failure SAV115 guards one replica against. These functions sit
+    outside SAV101's fit/evaluate scope and outside
+    SAV111/SAV112/SAV115/SAV116's sets, so SAV118 owns them.
+    """
+
+    id = "SAV118"
+    name = "router-hot-path-sync"
+    severity = "error"
+    hint = (
+        "keep the router's admission/routing/drain path host-only (it "
+        "routes on parsed heartbeat lines and its own counters — no "
+        "device value belongs in reach); if a sync here is truly "
+        "intentional, pragma it with a justification"
+    )
+
+    # The router's hot surface. Deliberately DISJOINT from SAV101's
+    # HOT_FUNCTIONS and the SAV111/SAV112/SAV115/SAV116 sets (overlap
+    # would double-report the same call).
+    ROUTER_FUNCTIONS = frozenset(
+        {"admit", "route", "note_result", "_refresh_views", "drain",
+         "resume"}
+    )
+
+    def check(self, module):
+        for fn in module.functions:
+            if fn.name in self.ROUTER_FUNCTIONS:
+                yield from _metrics_sync_findings(
+                    self, module, fn,
+                    where="router hot path",
+                    coda="routing must not sync the whole fleet",
+                )
+
+
 # ---------------------------------------------------------------- SAV117
 
 
@@ -1357,6 +1408,7 @@ ALL_RULES = [
     ServeHotLoopSync(),
     ServeTelemetryHotPathSync(),
     AdhocPartitionSpec(),
+    RouterHotPathSync(),
 ]
 
 

@@ -387,9 +387,19 @@ def write_probe_timeline(
 # ------------------------------------------------------------- aggregation
 
 
-def read_heartbeats(log_dir: str) -> dict[int, list[dict]]:
+def read_heartbeats(
+    log_dir: str, *, tail_bytes: Optional[int] = None
+) -> dict[int, list[dict]]:
     """Load every ``fleet/proc_*.jsonl`` stream; torn tail lines (a killed
-    writer) are skipped, like metrics.jsonl readers do."""
+    writer) are skipped, like metrics.jsonl readers do.
+
+    ``tail_bytes`` bounds the read to each file's trailing bytes — the
+    LIVE consumers' mode (the serve fleet router refreshes its view up
+    to every half second, and re-parsing a long run's full history on
+    each refresh would grow routing cost without bound). The partial
+    first line of a mid-file seek is dropped by the same torn-line
+    discipline. ``None`` (offline default) reads everything.
+    """
     root = fleet_dir(log_dir)
     out: dict[int, list[dict]] = {}
     if not os.path.isdir(root):
@@ -403,9 +413,16 @@ def read_heartbeats(log_dir: str) -> dict[int, list[dict]]:
             continue
         records = []
         try:
-            with open(os.path.join(root, name)) as f:
-                for line in f:
-                    line = line.strip()
+            with open(os.path.join(root, name), "rb") as f:
+                if tail_bytes is not None:
+                    f.seek(0, os.SEEK_END)
+                    size = f.tell()
+                    start = max(size - int(tail_bytes), 0)
+                    f.seek(start)
+                    if start > 0:
+                        f.readline()  # drop the partial first line
+                for raw in f:
+                    line = raw.decode("utf-8", "replace").strip()
                     if not line:
                         continue
                     try:
@@ -544,6 +561,63 @@ def _intervals(beats: list[dict]) -> list[dict]:
             interval["host_stall_frac"] = max(min(stall / dt, 1.0), 0.0)
         out.append(interval)
     return out
+
+
+_UNSET = object()
+
+
+def silence_suspects(
+    beat_times: dict[int, list],
+    finals: dict[int, bool],
+    *,
+    now: float,
+    suspect_factor: float = 3.0,
+    median_interval=_UNSET,
+) -> list[dict]:
+    """Missing-heartbeat dead-host suspicion, shared by the training
+    aggregator (:func:`aggregate_fleet`), the serving aggregator
+    (:func:`sav_tpu.serve.telemetry.aggregate_serve`) and the fleet
+    router's live view: a process silent for more than
+    ``suspect_factor`` x the fleet's median beat interval, without a
+    final record, likely went dark — "replica 1 stopped heartbeating",
+    not a symmetric timeout. One implementation so the router routes on
+    EXACTLY the flag the offline tools render.
+
+    ``beat_times``: per-process heartbeat unix stamps (ascending).
+    ``finals``: per-process "a final record exists" (an orderly close is
+    not a death). ``median_interval`` overrides the fleet-median
+    computed from ``beat_times`` — a caller that PASSES it owns the
+    baseline outright, including passing None for "no valid baseline
+    yet, flag nothing" (aggregate_fleet passes the median of its
+    step-filtered intervals: beats that advanced no step, e.g. through
+    a long first compile, carry no interval signal and must not
+    manufacture suspicion). Returns ``[{proc, last_unix, silent_s,
+    median_interval_s}]``, empty when no interval baseline exists yet.
+    """
+    med = median_interval
+    if med is _UNSET:
+        intervals = [
+            b - a
+            for times in beat_times.values()
+            for a, b in zip(times, times[1:])
+            if b > a
+        ]
+        med = _median(intervals)
+    if not med:
+        return []
+    suspects = []
+    for proc, times in sorted(beat_times.items()):
+        if not times or finals.get(proc):
+            continue
+        silent = float(now) - float(times[-1])
+        if silent > suspect_factor * med:
+            suspects.append({
+                "proc": proc,
+                "last_unix": times[-1],
+                "silent_s": round(silent, 3),
+                "median_interval_s": round(med, 3),
+            })
+    return suspects
 
 
 def _loo_scores(
@@ -739,23 +813,25 @@ def aggregate_fleet(
     # Missing-heartbeat dead-host suspicion: a process silent for more
     # than suspect_factor x the fleet's median heartbeat interval (and
     # without a final record) likely went dark — "process 5 stopped
-    # heartbeating at step 1240", not a symmetric timeout.
+    # heartbeating at step 1240", not a symmetric timeout. The detection
+    # body is silence_suspects(), shared with the serving aggregator and
+    # the fleet router; the median interval passed in is this
+    # aggregator's step-filtered one (beats that advanced no step carry
+    # no interval signal for training streams).
     all_intervals = [i["dt"] for iv in intervals.values() for i in iv]
-    med_interval = _median(all_intervals)
-    suspects = []
-    if med_interval:
-        for proc, hb in beats.items():
-            if not hb or finals.get(proc):
-                continue
-            silent = now - float(hb[-1].get("t", now))
-            if silent > suspect_factor * med_interval:
-                suspects.append({
-                    "proc": proc,
-                    "last_step": hb[-1].get("step"),
-                    "last_unix": hb[-1].get("t"),
-                    "silent_s": round(silent, 3),
-                    "median_interval_s": round(med_interval, 3),
-                })
+    suspects = silence_suspects(
+        {
+            proc: [float(r.get("t", 0.0)) for r in hb]
+            for proc, hb in beats.items()
+        },
+        {proc: bool(finals.get(proc)) for proc in beats},
+        now=now,
+        suspect_factor=suspect_factor,
+        median_interval=_median(all_intervals),
+    )
+    for s in suspects:
+        hb = beats.get(s["proc"]) or []
+        s["last_step"] = hb[-1].get("step") if hb else None
     summary["suspects"] = suspects
     return summary
 

@@ -40,7 +40,19 @@ _EXPORTS = {
     "SpanRing": "sav_tpu.serve.telemetry",
     "aggregate_serve": "sav_tpu.serve.telemetry",
     "export_chrome_trace": "sav_tpu.serve.telemetry",
+    "router_views": "sav_tpu.serve.telemetry",
     "stamp": "sav_tpu.serve.telemetry",
+    # Fleet (stdlib-only like the batcher: the pool's parent and the
+    # router must never be hangable by backend import — docs/serving.md
+    # "Fleet").
+    "ReplicaPool": "sav_tpu.serve.fleet",
+    "TcpTransport": "sav_tpu.serve.fleet",
+    "read_endpoints": "sav_tpu.serve.fleet",
+    "ReplicaShedError": "sav_tpu.serve.router",
+    "ReplicaTransportError": "sav_tpu.serve.router",
+    "Router": "sav_tpu.serve.router",
+    "RouterShedError": "sav_tpu.serve.router",
+    "projected_wait_s": "sav_tpu.serve.router",
 }
 
 __all__ = list(_EXPORTS)
@@ -48,6 +60,6 @@ __all__ = list(_EXPORTS)
 __getattr__, __dir__ = install_lazy_exports(
     globals(),
     _EXPORTS,
-    {"batcher", "bucketing", "engine", "latency", "preprocess",
-     "telemetry"},
+    {"batcher", "bucketing", "engine", "fleet", "latency", "preprocess",
+     "router", "telemetry"},
 )

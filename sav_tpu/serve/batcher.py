@@ -331,6 +331,17 @@ class DynamicBatcher:
         with self._lock:
             self._inflight = max(self._inflight - 1, 0)
 
+    def pending(self) -> int:
+        """Requests not yet resolved: queued + drained-but-uncompleted
+        batches (the latter in batch units — nonzero means the device
+        loop still owns work). The engine's ``drain()`` polls this to
+        zero before a graceful stop, so a replica leaving the fleet
+        (SIGTERM, weight swap) finishes what it accepted instead of
+        failing it — the fleet's accepted-never-silently-lost contract
+        (docs/serving.md)."""
+        with self._lock:
+            return self._queue.qsize() + self._inflight
+
     # ----------------------------------------------------------- shutdown
 
     def close(self) -> None:

@@ -778,6 +778,25 @@ class ServeEngine:
 
     # ----------------------------------------------------------- shutdown
 
+    def drain(self, timeout_s: float = 30.0, *, poll_s: float = 0.02) -> bool:
+        """Wait until every ACCEPTED request has resolved (queue empty,
+        no drained batch still on the device loop) — the graceful half
+        of leaving a fleet: a replica told to go away (SIGTERM from the
+        pool, a weight swap) stops ADMITTING first (its server closes
+        the listener), drains here, then :meth:`stop`s — nothing it
+        accepted is failed by its own shutdown. Returns True when fully
+        drained, False on timeout (stop() then fails the stragglers
+        loudly). Host-side polling only — no device sync beyond the
+        device loop's own."""
+        if self._batcher is None:
+            return True
+        deadline = time.monotonic() + float(timeout_s)
+        while self._batcher.pending() > 0:
+            if time.monotonic() >= deadline:
+                return False
+            time.sleep(poll_s)
+        return True
+
     def stop(
         self,
         timeout_s: float = 30.0,

@@ -1,0 +1,813 @@
+"""Wait-aware fleet router: admit, balance, drain, fail over.
+
+One :class:`~sav_tpu.serve.engine.ServeEngine` is one process on one
+chip group; the north star ("heavy traffic from millions of users")
+needs horizontal capacity — N engine replicas behind a router that
+spreads load by where it will actually finish soonest. This module is
+that router, deliberately **stdlib-only** (no jax, no numpy — the
+structural proof, like the batcher's, that routing cannot sync a device
+value; the router typically runs in the pool's parent process, which
+must never be hangable by backend import, the supervisor philosophy).
+
+Routing policy — **projected dispatch wait**, not round robin: each
+replica's live ``kind=serve`` heartbeat (sav_tpu/serve/telemetry.py)
+carries its queue depth, in-flight batch count, and measured per-batch
+step time; the router projects what a new request would wait at each
+replica with the SAME arithmetic the PR-10 batcher uses for its
+admission shed (:func:`projected_wait_s` — batches ahead x estimated
+step), adds the requests it has itself routed there since the last
+heartbeat (heartbeats are cadenced; the router's own outstanding count
+fills the staleness gap), and picks the minimum. A fleet whose *best*
+projected wait already blows the deadline sheds at admission
+(:class:`~sav_tpu.serve.batcher.DeadlineInfeasibleError`) — the
+batcher's "never serve a guaranteed miss" contract, lifted fleet-wide.
+
+Replica lifecycle the router tracks (docs/serving.md "Fleet"):
+
+- **active** — routable.
+- **draining** — the leave-one-out straggler attribution
+  (:func:`sav_tpu.obs.fleet._loo_scores`, the PR-7 machinery, here on
+  windowed p99) flagged the replica: no NEW requests are routed to it,
+  its in-flight work finishes normally, and it resumes the moment the
+  attribution unflags it. The router never drains the last active
+  replica — degraded capacity beats none.
+- **down** — a transport failure (connection refused/reset: the
+  process died mid-request) or heartbeat-silence suspicion
+  (:func:`sav_tpu.obs.fleet.silence_suspects` — the same flag
+  ``aggregate_serve``/``serve_status`` render) marks the replica dead.
+  Requests in flight to it come back as transport errors and are
+  REROUTED to a healthy replica while their deadline still stands —
+  rerouted or honestly shed, never silently lost. Recovery is a fresh
+  heartbeat newer than the down mark (the PR-9 supervisor restarts the
+  process; its first beat folds it back in).
+
+savlint SAV118 (``router-hot-path-sync``) owns this module's hot
+functions (``admit`` / ``route`` / ``note_result`` / ``_refresh_views``
+/ ``drain`` / ``resume``): a device sync anywhere in the routing path
+would serialize every request in the fleet behind one pipeline drain.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import queue as _queue_mod
+import threading
+import time
+from typing import Any, Callable, Optional
+
+from sav_tpu.obs.fleet import _loo_scores
+from sav_tpu.serve.batcher import (
+    DeadlineInfeasibleError,
+    QueueFullError,
+    ServeClosedError,
+    ServeFuture,
+)
+
+ROUTER_SCHEMA = 1
+
+#: Replica states (docs/serving.md "Fleet" state table).
+ACTIVE = "active"
+DRAINING = "draining"
+DOWN = "down"
+
+
+class ReplicaTransportError(RuntimeError):
+    """The transport could not complete the exchange (connection
+    refused/reset, torn reply): the replica process is gone or going.
+    The router marks the replica down and REROUTES the request."""
+
+
+class ReplicaShedError(QueueFullError):
+    """The replica itself shed the request (its admission control
+    rejected it). Retried elsewhere/later while the deadline stands."""
+
+
+class RouterShedError(QueueFullError):
+    """No replica could serve the request before its deadline — the
+    router's honest shed (set on the future; never a silent drop)."""
+
+
+def projected_wait_s(
+    *,
+    queued: int,
+    inflight: int,
+    fresh_outstanding: int,
+    max_batch: int,
+    est_step_s: float,
+) -> float:
+    """Projected dispatch wait at one replica, in the batcher's own
+    arithmetic (sav_tpu/serve/batcher.py submit): the batches already
+    drained-but-not-completed (``inflight``) plus the full batches the
+    queue ahead would form — ``queued`` from the replica's last
+    heartbeat plus ``fresh_outstanding``, the requests this router has
+    sent since that heartbeat (cadenced beats are stale; the router's
+    own ledger fills the gap) — each one estimated step. The ``+
+    max_batch`` inside the ceiling counts the batch this request itself
+    would ride, exactly like the batcher's ``(qsize + max_batch) //
+    max_batch``."""
+    max_batch = max(int(max_batch), 1)
+    batches_ahead = max(int(inflight), 0) + (
+        (max(int(queued), 0) + max(int(fresh_outstanding), 0) + max_batch)
+        // max_batch
+    )
+    return batches_ahead * max(float(est_step_s), 0.0)
+
+
+class _Replica:
+    """Router-side live state for one replica (owner locks)."""
+
+    __slots__ = (
+        "rank", "state", "queued", "inflight", "est_step_s", "p99_ms",
+        "last_beat_unix", "beats", "final", "pid", "sends", "routed",
+        "completed", "failures", "down_since_unix", "down_reason",
+        "drained_at_unix", "drain_auto",
+    )
+
+    def __init__(self, rank: int):
+        self.rank = rank
+        self.state = ACTIVE
+        self.queued = 0
+        self.inflight = 0
+        self.est_step_s: Optional[float] = None
+        self.p99_ms: Optional[float] = None
+        self.last_beat_unix: Optional[float] = None
+        self.beats = 0
+        self.final = False
+        self.pid: Optional[int] = None
+        # In-flight sends: job id -> wall stamp (fresh_outstanding =
+        # sends newer than the replica's last heartbeat).
+        self.sends: dict = {}
+        self.routed = 0
+        self.completed = 0
+        self.failures = 0
+        self.down_since_unix: Optional[float] = None
+        self.down_reason: Optional[str] = None
+        self.drained_at_unix: Optional[float] = None
+        self.drain_auto = False
+
+    def fresh_outstanding(self) -> int:
+        beat_t = self.last_beat_unix
+        if beat_t is None:
+            return len(self.sends)
+        return sum(1 for t in self.sends.values() if t > beat_t)
+
+    def view(self) -> dict:
+        return {
+            "rank": self.rank,
+            "state": self.state,
+            "queued": self.queued,
+            "inflight": self.inflight,
+            "outstanding": len(self.sends),
+            "est_step_s": self.est_step_s,
+            "p99_ms": self.p99_ms,
+            "last_beat_unix": self.last_beat_unix,
+            "beats": self.beats,
+            "routed": self.routed,
+            "completed": self.completed,
+            "failures": self.failures,
+            "down_reason": self.down_reason,
+        }
+
+
+class _Job:
+    __slots__ = ("jid", "payload", "meta", "deadline_t", "admit_t", "future")
+
+    def __init__(self, jid, payload, meta, deadline_t, admit_t, future):
+        self.jid = jid
+        self.payload = payload
+        self.meta = meta
+        self.deadline_t = deadline_t
+        self.admit_t = admit_t
+        self.future = future
+
+
+_STOP = object()
+
+
+class Router:
+    """Admission + load balancing over a serve replica fleet.
+
+    Args:
+      transport: the wire to the replicas —
+        ``send(rank, payload, meta, timeout_s) -> dict`` (raising
+        :class:`ReplicaTransportError` on a dead connection and
+        :class:`ReplicaShedError` on a replica-side admission reject).
+        :class:`sav_tpu.serve.fleet.TcpTransport` is the production
+        implementation; tests inject fakes.
+      views_fn: ``() -> {rank: view}`` — the per-replica live view
+        (:func:`sav_tpu.serve.telemetry.router_views` reads it from the
+        ``kind=serve`` heartbeat streams). Each view carries ``queued``
+        / ``inflight`` / ``est_step_s`` / ``p99_ms`` /
+        ``last_beat_unix`` / ``beats`` / ``final`` / ``suspect``.
+      max_batch: the replicas' top bucket (the projection's batch unit).
+      default_step_s: per-batch step estimate before the first heartbeat
+        carries a measured one.
+      default_deadline_s / max_inflight: admission knobs (the fleet
+        twins of the batcher's ``default_deadline_s`` / ``max_queue``).
+      refresh_secs: heartbeat-view refresh cadence (admission and the
+        dispatch loop refresh at most this often).
+      straggler_k / straggler_rel_floor / straggler_min_beats: the
+        leave-one-out p99 drain gate (conservative by default — with a
+        2-replica fleet the LOO baseline is a single value, so the
+        relative floor alone separates "slower" from "straggling").
+      ranks: the expected fleet roster — pre-seeds the routing table
+        (active, no data) so replicas are routable from the first
+        request, BEFORE their first heartbeat lands (a fresh fleet's
+        beats are cadenced; waiting for them would funnel the whole
+        warmup flood at whichever replica beat first). None = discover
+        from heartbeats alone.
+      workers: dispatch worker threads. ``0`` = synchronous mode —
+        ``admit`` dispatches inline and blocks until the request
+        completes or sheds (deterministic unit tests; single-threaded
+        drivers).
+      clock / wall_clock / sleep: injectable for fake-clock tests.
+      log_dir: when set, ``close()`` writes the router summary to
+        ``<log_dir>/fleet/router.json`` for ``serve_status``.
+    """
+
+    _POLL_S = 0.02  # no-routable-replica retry cadence inside dispatch
+
+    def __init__(
+        self,
+        transport,
+        *,
+        views_fn: Callable[[], dict],
+        max_batch: int = 8,
+        default_step_s: float = 0.05,
+        default_deadline_s: float = 1.0,
+        max_inflight: int = 256,
+        refresh_secs: float = 0.5,
+        suspect_factor: float = 3.0,
+        straggler_k: float = 3.5,
+        straggler_rel_floor: float = 1.0,
+        straggler_min_beats: int = 3,
+        ranks=None,
+        workers: int = 8,
+        clock: Callable[[], float] = time.monotonic,
+        wall_clock: Callable[[], float] = time.time,
+        sleep: Callable[[float], None] = time.sleep,
+        log_dir: Optional[str] = None,
+    ):
+        if max_inflight < 1:
+            raise ValueError(f"max_inflight must be >= 1, got {max_inflight}")
+        if default_deadline_s <= 0:
+            raise ValueError(
+                f"default_deadline_s must be > 0, got {default_deadline_s}"
+            )
+        self._transport = transport
+        self._views_fn = views_fn
+        self.max_batch = int(max_batch)
+        self.default_step_s = float(default_step_s)
+        self.default_deadline_s = float(default_deadline_s)
+        self.max_inflight = int(max_inflight)
+        self.refresh_secs = float(refresh_secs)
+        self.suspect_factor = float(suspect_factor)
+        self.straggler_k = float(straggler_k)
+        self.straggler_rel_floor = float(straggler_rel_floor)
+        self.straggler_min_beats = int(straggler_min_beats)
+        self.log_dir = log_dir
+        self._clock = clock
+        self._wall = wall_clock
+        self._sleep = sleep
+        self._lock = threading.Lock()
+        self._replicas: dict[int, _Replica] = {}
+        self._closed = threading.Event()
+        self._jid = 0
+        self._inflight_total = 0
+        self._last_refresh: Optional[float] = None
+        self._t_start = clock()
+        self._first_admit_t: Optional[float] = None
+        self._last_complete_t: Optional[float] = None
+        self._latencies_s: list = []
+        self._completed = 0
+        self._rejected = 0
+        self._shed_admit = 0
+        self._shed_deadline = 0
+        self._rerouted = 0
+        self._transport_failures = 0
+        self._errors = 0
+        for rank in (ranks or ()):
+            self._replicas[int(rank)] = _Replica(int(rank))
+        self._refresh_views()  # seed the table before the first admit
+        self._jobs: Any = _queue_mod.Queue()
+        self._workers = []
+        for i in range(int(workers)):
+            t = threading.Thread(
+                target=self._worker, name=f"router-dispatch-{i}", daemon=True
+            )
+            t.start()
+            self._workers.append(t)
+
+    # ----------------------------------------------------------- admission
+
+    def admit(
+        self,
+        payload: Any,
+        *,
+        deadline_s: Optional[float] = None,
+        meta: Optional[dict] = None,
+    ) -> ServeFuture:
+        """Admit one request into the fleet; returns its future.
+
+        Sheds at admission (:class:`DeadlineInfeasibleError`) when even
+        the BEST replica's projected dispatch wait blows the deadline —
+        the batcher's guaranteed-miss contract, fleet-wide — and
+        rejects (:class:`QueueFullError`) past ``max_inflight``. Both
+        reject shapes subclass :class:`QueueFullError`, like the
+        batcher's. Host bookkeeping only (savlint SAV118)."""
+        if self._closed.is_set():
+            raise ServeClosedError("router is closed")
+        deadline_s = (
+            float(deadline_s) if deadline_s is not None
+            else self.default_deadline_s
+        )
+        if deadline_s <= 0:
+            raise ValueError(f"deadline_s must be > 0, got {deadline_s}")
+        self._maybe_refresh()
+        # Capacity check, shed projection, and the inflight increment in
+        # ONE critical section: a check in a separate lock acquisition
+        # would let N concurrent admitters all pass at capacity-1 and
+        # overshoot the bound by the caller thread count.
+        with self._lock:
+            if self._inflight_total >= self.max_inflight:
+                self._rejected += 1
+                raise QueueFullError(
+                    f"router at capacity ({self.max_inflight} in flight); "
+                    "shed load or raise max_inflight"
+                )
+            waits = [
+                self._projected_wait(r)
+                for r in self._replicas.values()
+                if r.state == ACTIVE
+            ]
+            if waits and min(waits) > deadline_s:
+                self._shed_admit += 1
+                raise DeadlineInfeasibleError(
+                    f"best projected dispatch wait {min(waits):.3f}s across "
+                    f"{len(waits)} active replica(s) exceeds the "
+                    f"{deadline_s:.3f}s deadline; shedding instead of "
+                    "serving a guaranteed miss"
+                )
+            self._jid += 1
+            now = self._clock()
+            if self._first_admit_t is None:
+                self._first_admit_t = now
+            job = _Job(
+                self._jid, payload, dict(meta or {}),
+                now + deadline_s, now, ServeFuture(),
+            )
+            self._inflight_total += 1
+        if self._workers:
+            self._jobs.put(job)
+            if self._closed.is_set():
+                # close() can finish draining the queue and stopping
+                # the workers between this thread's entry check and the
+                # put above; the job would then sit in a queue nothing
+                # will ever drain, stranding result() forever. Re-run
+                # the fail pass (the batcher's PR-10 submit/close
+                # TOCTOU fix, same shape) — any job still queued after
+                # close must fail anyway.
+                self._fail_queued_jobs()
+        else:
+            self._dispatch(job)  # synchronous mode: block until resolved
+        return job.future
+
+    def _projected_wait(self, replica: _Replica) -> float:
+        est = replica.est_step_s
+        if est is None:
+            # No measured step yet (fresh replica / just restarted):
+            # be OPTIMISTIC — assume the best measured step in the
+            # fleet, so the unknown replica gets traffic and its
+            # estimate gets measured. A pessimistic default would
+            # repel traffic forever: no traffic, no measurement, no
+            # recovery from the default (the fold-back deadlock).
+            known = [
+                r.est_step_s for r in self._replicas.values()
+                if r.est_step_s is not None
+            ]
+            est = min(known) if known else self.default_step_s
+        return projected_wait_s(
+            queued=replica.queued,
+            inflight=replica.inflight,
+            fresh_outstanding=replica.fresh_outstanding(),
+            max_batch=self.max_batch,
+            est_step_s=est,
+        )
+
+    def route(self) -> Optional[int]:
+        """The replica a new request should go to: minimum projected
+        dispatch wait among ACTIVE replicas (ties break to the lowest
+        rank — deterministic), or None when nothing is routable (all
+        down/draining — the dispatch loop polls for recovery until the
+        deadline). Host arithmetic only (SAV118)."""
+        with self._lock:
+            best = None
+            best_wait = None
+            for rank in sorted(self._replicas):
+                replica = self._replicas[rank]
+                if replica.state != ACTIVE:
+                    continue
+                wait = self._projected_wait(replica)
+                if best_wait is None or wait < best_wait:
+                    best, best_wait = rank, wait
+            return best
+
+    # ------------------------------------------------------------ dispatch
+
+    def _worker(self) -> None:
+        while True:
+            job = self._jobs.get()
+            if job is _STOP:
+                return
+            self._dispatch(job)
+
+    def _dispatch(self, job: _Job) -> None:
+        """Route one admitted request until it completes, sheds, or the
+        router closes: send to the best replica; a transport failure
+        marks the replica down and REROUTES while the deadline stands
+        (never silently lost); a replica-side shed retries as capacity
+        frees; past the deadline the future fails with
+        :class:`RouterShedError` — the honest shed."""
+        try:
+            while True:
+                if self._closed.is_set():
+                    job.future.set_exception(
+                        ServeClosedError("router closed with this request "
+                                         "in flight")
+                    )
+                    return
+                # Keep the view fresh on the dispatch path too: under a
+                # flood, admissions stop long before dispatch does, and
+                # a router working a whole drain on its admission-time
+                # view would never see queues build or replicas die.
+                self._maybe_refresh()
+                remaining = job.deadline_t - self._clock()
+                if remaining <= 0:
+                    with self._lock:
+                        self._shed_deadline += 1
+                    job.future.set_exception(RouterShedError(
+                        "no replica could serve this request before its "
+                        "deadline (rerouted/retried until the budget ran "
+                        "out) — shed, not silently dropped"
+                    ))
+                    return
+                rank = self.route()
+                if rank is None:
+                    self._sleep(min(self._POLL_S, remaining))
+                    self._maybe_refresh()
+                    continue
+                with self._lock:
+                    replica = self._replicas.get(rank)
+                    if replica is None:
+                        continue
+                    replica.routed += 1
+                    replica.sends[job.jid] = self._wall()
+                try:
+                    result = self._transport.send(
+                        rank, job.payload, job.meta, remaining
+                    )
+                except ReplicaShedError:
+                    self.note_result(rank, job.jid, ok=False)
+                    # The replica's own admission control is loaded:
+                    # back off briefly and retry (here or elsewhere)
+                    # while the deadline stands.
+                    self._sleep(min(self._POLL_S, remaining))
+                    self._maybe_refresh()
+                    continue
+                except ReplicaTransportError as e:
+                    self.note_result(rank, job.jid, ok=False)
+                    with self._lock:
+                        self._transport_failures += 1
+                        self._rerouted += 1
+                    self._mark_down(rank, reason=f"transport: {e}")
+                    continue
+                except Exception as e:  # noqa: BLE001 — replica app error
+                    self.note_result(rank, job.jid, ok=False)
+                    with self._lock:
+                        self._errors += 1
+                    job.future.set_exception(e)
+                    return
+                self.note_result(rank, job.jid, ok=True)
+                now = self._clock()
+                with self._lock:
+                    self._completed += 1
+                    self._latencies_s.append(now - job.admit_t)
+                    self._last_complete_t = now
+                job.future.set_result(result)
+                return
+        finally:
+            with self._lock:
+                self._inflight_total = max(self._inflight_total - 1, 0)
+
+    def note_result(self, rank: int, jid: int, *, ok: bool) -> None:
+        """Completion bookkeeping for one send (host counters only,
+        SAV118): the projection stops counting it as outstanding."""
+        with self._lock:
+            replica = self._replicas.get(rank)
+            if replica is None:
+                return
+            replica.sends.pop(jid, None)
+            if ok:
+                replica.completed += 1
+            else:
+                replica.failures += 1
+
+    # ----------------------------------------------------- replica states
+
+    def _mark_down(self, rank: int, *, reason: str) -> None:
+        with self._lock:
+            replica = self._replicas.get(rank)
+            if replica is None or replica.state == DOWN:
+                return
+            replica.state = DOWN
+            replica.down_since_unix = self._wall()
+            replica.down_reason = reason
+
+    def drain(
+        self, rank: int, *, reason: str = "manual", auto: bool = False
+    ) -> bool:
+        """Stop routing NEW requests to a replica; its in-flight work
+        finishes normally (the futures resolve as results arrive). The
+        straggler attribution calls this automatically (``auto`` — and
+        only auto drains auto-RESUME when the attribution unflags; a
+        manual drain stays until :meth:`resume`). Refuses to drain the
+        last active replica. Host-only (SAV118)."""
+        with self._lock:
+            replica = self._replicas.get(rank)
+            if replica is None or replica.state != ACTIVE:
+                return False
+            active = sum(
+                1 for r in self._replicas.values() if r.state == ACTIVE
+            )
+            if active <= 1:
+                return False  # degraded capacity beats none
+            replica.state = DRAINING
+            replica.drained_at_unix = self._wall()
+            replica.down_reason = reason
+            replica.drain_auto = bool(auto)
+            return True
+
+    def resume(self, rank: int) -> bool:
+        """Fold a draining/down replica back into rotation (the
+        recovery path calls this when a fresh heartbeat arrives)."""
+        with self._lock:
+            replica = self._replicas.get(rank)
+            if replica is None or replica.state == ACTIVE:
+                return False
+            replica.state = ACTIVE
+            replica.down_since_unix = None
+            replica.down_reason = None
+            replica.drained_at_unix = None
+            replica.drain_auto = False
+            return True
+
+    # -------------------------------------------------------- view refresh
+
+    def refresh(self) -> None:
+        """Force a heartbeat-view refresh NOW (drivers polling for a
+        replica's recovery — e.g. the chaos arm's fold-back probe —
+        should not wait out the cadence)."""
+        self._refresh_views()
+
+    def _maybe_refresh(self) -> None:
+        now = self._clock()
+        if (
+            self._last_refresh is not None
+            and now - self._last_refresh < self.refresh_secs
+        ):
+            return
+        self._refresh_views()
+
+    def _refresh_views(self) -> None:
+        """Fold the live heartbeat views into the routing table: update
+        each replica's queue/step estimates, mark heartbeat-silent
+        replicas down (the silence_suspects flag), recover replicas
+        whose beats resumed, and run the leave-one-out straggler gate
+        on windowed p99 (drain flagged, resume unflagged). Host-only by
+        contract — savlint SAV118 owns this body; every value read here
+        is a parsed JSON line."""
+        self._last_refresh = self._clock()
+        try:
+            views = self._views_fn() or {}
+        except Exception:  # noqa: BLE001 — a torn read must not stop routing
+            return
+        with self._lock:
+            for rank, view in views.items():
+                rank = int(rank)
+                replica = self._replicas.get(rank)
+                if replica is None:
+                    replica = self._replicas[rank] = _Replica(rank)
+                queued = view.get("queued")
+                inflight = view.get("inflight")
+                replica.queued = int(queued) if queued is not None else 0
+                replica.inflight = (
+                    int(inflight) if inflight is not None else 0
+                )
+                est = view.get("est_step_s")
+                if isinstance(est, (int, float)) and est > 0:
+                    replica.est_step_s = float(est)
+                p99 = view.get("p99_ms")
+                replica.p99_ms = (
+                    float(p99) if isinstance(p99, (int, float)) else None
+                )
+                beat_t = view.get("last_beat_unix")
+                if isinstance(beat_t, (int, float)):
+                    replica.last_beat_unix = float(beat_t)
+                replica.beats = int(view.get("beats") or 0)
+                replica.final = bool(view.get("final"))
+                pid = view.get("pid")
+                if pid is not None:
+                    if replica.pid is not None and replica.pid != pid:
+                        # A new process took this rank (supervisor
+                        # restart): the old outstanding ledger is dead
+                        # weight against the fresh replica's projection.
+                        replica.sends.clear()
+                    replica.pid = pid
+                # Dead suspicion / recovery. An orderly final record is
+                # a close, not a death — down, but not suspicion-tagged.
+                if view.get("suspect") or replica.final:
+                    if replica.state != DOWN:
+                        replica.state = DOWN
+                        replica.down_since_unix = self._wall()
+                        replica.down_reason = (
+                            "final record" if replica.final
+                            else "heartbeat-silent"
+                        )
+                elif (
+                    replica.state == DOWN
+                    and replica.last_beat_unix is not None
+                    and (
+                        replica.down_since_unix is None
+                        or replica.last_beat_unix > replica.down_since_unix
+                    )
+                ):
+                    # Fresh beat after the down mark: the supervisor
+                    # restarted it (or the silence healed) — fold it
+                    # back in.
+                    replica.state = ACTIVE
+                    replica.down_since_unix = None
+                    replica.down_reason = None
+            # Straggler gate: LOO median+MAD on windowed p99 across the
+            # replicas that have one (the sentinel machinery, PR-7's
+            # fleet application — one robust-stats implementation).
+            p99s = {
+                rank: r.p99_ms
+                for rank, r in self._replicas.items()
+                if r.p99_ms is not None
+                and r.beats >= self.straggler_min_beats
+                and r.state in (ACTIVE, DRAINING)
+            }
+            flagged = set()
+            if len(p99s) >= 2:
+                scores = _loo_scores(
+                    p99s, k=self.straggler_k,
+                    rel_floor=self.straggler_rel_floor,
+                )
+                flagged = {
+                    rank for rank, s in scores.items() if s["flagged"]
+                }
+        for rank in sorted(flagged):
+            self.drain(rank, reason="straggler (LOO p99)", auto=True)
+        with self._lock:
+            unflag = [
+                rank for rank, r in self._replicas.items()
+                if r.state == DRAINING and r.drain_auto
+                and rank not in flagged
+            ]
+        for rank in unflag:
+            self.resume(rank)
+
+    # ----------------------------------------------------------- lifecycle
+
+    def close(self) -> None:
+        """Stop admission, fail requests still queued for dispatch
+        (:class:`ServeClosedError`), and join the workers. Requests a
+        worker already sent complete normally. Idempotent."""
+        if self._closed.is_set():
+            return
+        self._closed.set()
+        # Fail everything still queued (workers check closed before
+        # sending; the sentinel wakes them for shutdown).
+        self._fail_queued_jobs()
+        for _ in self._workers:
+            self._jobs.put(_STOP)
+        for t in self._workers:
+            t.join(timeout=5.0)
+        if self.log_dir:
+            self.write_summary()
+
+    def _fail_queued_jobs(self) -> None:
+        """Fail every queued job's future (close()'s pass; admit()
+        re-runs it when its enqueue raced close). Worker shutdown
+        sentinels drained in passing are re-enqueued — admit's re-run
+        can execute after close() armed them, and swallowing one would
+        leave a worker blocked forever on the queue."""
+        stops = 0
+        while True:
+            try:
+                job = self._jobs.get_nowait()
+            except _queue_mod.Empty:
+                break
+            if job is _STOP:
+                stops += 1
+                continue
+            job.future.set_exception(
+                ServeClosedError("router closed before this request shipped")
+            )
+            with self._lock:
+                self._inflight_total = max(self._inflight_total - 1, 0)
+        for _ in range(stops):
+            self._jobs.put(_STOP)
+
+    @property
+    def closed(self) -> bool:
+        return self._closed.is_set()
+
+    # ------------------------------------------------------------- reading
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {
+                "completed": self._completed,
+                "rejected": self._rejected,
+                "shed_admit": self._shed_admit,
+                "shed_deadline": self._shed_deadline,
+                "rerouted": self._rerouted,
+                "transport_failures": self._transport_failures,
+                "errors": self._errors,
+                "inflight": self._inflight_total,
+                "replicas": {
+                    str(rank): r.view()
+                    for rank, r in sorted(self._replicas.items())
+                },
+            }
+
+    def summary(self) -> dict:
+        """The fleet-level serving headline: router-observed end-to-end
+        latency percentiles (admit -> result), throughput over the
+        serving span, and the shed/reroute accounting the chaos proof
+        audits (completed + shed == admitted, nothing silently lost)."""
+        from sav_tpu.serve.latency import percentile
+
+        with self._lock:
+            lat = sorted(self._latencies_s)
+            span = None
+            if (
+                self._first_admit_t is not None
+                and self._last_complete_t is not None
+            ):
+                span = max(self._last_complete_t - self._first_admit_t, 1e-9)
+            shed = self._shed_admit + self._shed_deadline
+            out = {
+                "schema": ROUTER_SCHEMA,
+                "completed": self._completed,
+                "rejected": self._rejected,
+                "shed": shed,
+                "shed_admit": self._shed_admit,
+                "shed_deadline": self._shed_deadline,
+                "rerouted": self._rerouted,
+                "transport_failures": self._transport_failures,
+                "errors": self._errors,
+                "latency_ms": {
+                    "p50": round(percentile(lat, 50.0) * 1e3, 3) if lat else None,
+                    "p95": round(percentile(lat, 95.0) * 1e3, 3) if lat else None,
+                    "p99": round(percentile(lat, 99.0) * 1e3, 3) if lat else None,
+                },
+                "throughput_rps": (
+                    round(self._completed / span, 2) if span else None
+                ),
+                "replicas": {
+                    str(rank): r.view()
+                    for rank, r in sorted(self._replicas.items())
+                },
+            }
+        return out
+
+    def write_summary(self) -> Optional[str]:
+        """Persist the router summary to ``<log_dir>/fleet/router.json``
+        (atomic; telemetry never raises) — ``serve_status`` renders it
+        next to the per-replica heartbeat views."""
+        if not self.log_dir:
+            return None
+        path = os.path.join(self.log_dir, "fleet", "router.json")
+        try:
+            os.makedirs(os.path.dirname(path), exist_ok=True)
+            tmp = f"{path}.tmp.{os.getpid()}"
+            with open(tmp, "w") as f:
+                json.dump(self.summary(), f, indent=2, default=str)
+            os.replace(tmp, path)
+            return path
+        except OSError:
+            return None
+
+
+def read_router_summary(log_dir: str) -> Optional[dict]:
+    """The persisted router summary (``fleet/router.json``), or None —
+    the offline readers' (serve_status) side of :meth:`write_summary`."""
+    try:
+        with open(os.path.join(log_dir, "fleet", "router.json")) as f:
+            doc = json.load(f)
+    except (OSError, json.JSONDecodeError):
+        return None
+    return doc if isinstance(doc, dict) else None

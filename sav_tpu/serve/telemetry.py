@@ -80,6 +80,7 @@ from sav_tpu.obs.fleet import (
     _median,
     iter_manifests,
     read_heartbeats,
+    silence_suspects,
 )
 
 SERVE_TELEMETRY_SCHEMA = 1
@@ -982,26 +983,78 @@ class ServeTelemetry:
 # -------------------------------------------------------- offline readers
 
 
+def _serve_streams(
+    log_dir: str, *, tail_bytes: Optional[int] = None
+) -> tuple:
+    """``(streams, finals)``: per-process ``kind=serve`` beats plus a
+    per-process "closed" flag — the ONE filtering body behind
+    :func:`read_serve_beats`, :func:`aggregate_serve` and the router's
+    live view. ``finals[proc]`` is True only when the newest ``final``
+    record is at least as new as the newest serve beat: the streams are
+    append-only across restarts, so a final from a PREVIOUS process
+    generation (a graceful stop before a pool restart) must not mark
+    the freshly-beating replica as closed — that would down every
+    replica of a reused log dir forever (same recency rule for the
+    suspicion's "an orderly close is not a death" exemption)."""
+    streams: dict = {}
+    finals: dict = {}
+    for proc, records in read_heartbeats(
+        log_dir, tail_bytes=tail_bytes
+    ).items():
+        serve = [r for r in records if r.get("kind") == "serve"]
+        if not serve:
+            continue
+        streams[proc] = serve
+        last_final = max(
+            (
+                float(r.get("t", 0.0)) for r in records
+                if r.get("kind") == "final"
+            ),
+            default=None,
+        )
+        finals[proc] = (
+            last_final is not None
+            and last_final >= float(serve[-1].get("t", 0.0))
+        )
+    return streams, finals
+
+
 def read_serve_beats(log_dir: str) -> dict:
     """Per-process ``kind=serve`` heartbeat records from the fleet
     streams (``fleet/proc_*.jsonl`` — same files, same torn-tail
     discipline as training heartbeats)."""
-    out = {}
-    for proc, records in read_heartbeats(log_dir).items():
-        serve = [r for r in records if r.get("kind") == "serve"]
-        if serve:
-            out[proc] = serve
-    return out
+    return _serve_streams(log_dir)[0]
 
 
-def aggregate_serve(log_dir: str, *, max_timeline: int = 120) -> dict:
+def aggregate_serve(
+    log_dir: str,
+    *,
+    max_timeline: int = 120,
+    now: Optional[float] = None,
+    suspect_factor: float = 3.0,
+    tail_bytes: Optional[int] = None,
+) -> dict:
     """Fold the serve heartbeat streams into the per-replica fleet view.
 
     This is the ROADMAP item-3 router input: per replica, the latest
     windowed p99 / queue depth / inflight / occupancy, plus SLO burn
     state — recomputable offline from artifacts alone (stdlib-only).
+
+    Dead-replica suspicion rides the same summary (the flag
+    ``aggregate_fleet`` has carried for training streams since PR 7,
+    via the shared :func:`sav_tpu.obs.fleet.silence_suspects` body): a
+    replica silent for more than ``suspect_factor`` x the fleet median
+    beat interval, with no final record, is listed in ``suspects`` and
+    flagged ``suspect`` in its view — a SIGKILLed replica shows up as
+    "replica 1 stopped heartbeating", not by vanishing from
+    ``serve_status``. The fleet router routes on EXACTLY this flag
+    (:func:`router_views`). ``now`` defaults to the newest heartbeat
+    across the fleet (offline semantics — wall clock would flag every
+    replica of a finished run); the live router passes the wall clock
+    (and a ``tail_bytes`` bound, so refreshing the view every half
+    second never re-parses a long run's full history).
     """
-    streams = read_serve_beats(log_dir)
+    streams, finals = _serve_streams(log_dir, tail_bytes=tail_bytes)
     summary: dict = {
         "schema": SERVE_TELEMETRY_SCHEMA,
         "log_dir": log_dir,
@@ -1009,6 +1062,21 @@ def aggregate_serve(log_dir: str, *, max_timeline: int = 120) -> dict:
     }
     if not streams:
         return summary
+    if now is None:
+        now = max(
+            float(b.get("t", 0.0)) for beats in streams.values()
+            for b in beats
+        )
+    suspects = silence_suspects(
+        {
+            proc: [float(b.get("t", 0.0)) for b in beats]
+            for proc, beats in streams.items()
+        },
+        finals,
+        now=float(now),
+        suspect_factor=suspect_factor,
+    )
+    suspect_procs = {s["proc"] for s in suspects}
     timeline = []
     for proc, beats in streams.items():
         last = beats[-1]
@@ -1030,6 +1098,7 @@ def aggregate_serve(log_dir: str, *, max_timeline: int = 120) -> dict:
             "inflight": last.get("inflight"),
             "p99_ms": w.get("p99_ms"),
             "throughput_rps": w.get("throughput_rps"),
+            "step_s_avg": w.get("step_s_avg"),
             "queue_depth": w.get("queue_depth_last"),
             "occupancy": w.get("occupancy"),
             "padding_waste_frac": w.get("padding_waste_frac"),
@@ -1042,6 +1111,9 @@ def aggregate_serve(log_dir: str, *, max_timeline: int = 120) -> dict:
             "exemplars": last.get("exemplars"),
             "captures": last.get("captures"),
             "hbm_peak_bytes": last.get("hbm_peak_bytes"),
+            "pid": last.get("pid"),
+            "final": bool(finals.get(proc)),
+            "suspect": proc in suspect_procs,
         }
         summary["replicas"][str(proc)] = view
         for b in beats:
@@ -1067,6 +1139,7 @@ def aggregate_serve(log_dir: str, *, max_timeline: int = 120) -> dict:
         v["p99_ms"] for v in replicas
         if isinstance(v.get("p99_ms"), (int, float))
     ]
+    summary["suspects"] = suspects
     summary["fleet"] = {
         "replicas": len(summary["replicas"]),
         "throughput_rps": round(sum(rps), 2) if rps else None,
@@ -1074,8 +1147,55 @@ def aggregate_serve(log_dir: str, *, max_timeline: int = 120) -> dict:
         "burning": sorted(
             int(p) for p, v in summary["replicas"].items() if v.get("burning")
         ),
+        "suspects": sorted(s["proc"] for s in suspects),
     }
     return summary
+
+
+#: Default per-stream read bound for the LIVE router view: enough for
+#: hours of beats at the default cadence, constant-cost per refresh.
+ROUTER_VIEW_TAIL_BYTES = 256 * 1024
+
+
+def router_views(
+    log_dir: str,
+    *,
+    now: Optional[float] = None,
+    suspect_factor: float = 3.0,
+    tail_bytes: Optional[int] = ROUTER_VIEW_TAIL_BYTES,
+) -> dict:
+    """The fleet router's live per-replica view (``Router.views_fn``):
+    queue depth / inflight / measured per-batch step / windowed p99 /
+    beat recency / dead suspicion, read from the same ``kind=serve``
+    heartbeat streams ``aggregate_serve`` folds offline — the router
+    balances on the numbers the offline tools render, by construction.
+    ``now`` defaults to the wall clock (live semantics: a replica that
+    stopped beating IS suspect, unlike the offline default). Reads are
+    tail-bounded by default: a long-lived router refreshes up to every
+    half second, and re-parsing the full history each time would grow
+    routing cost with run age (``tail_bytes=None`` = full read)."""
+    now = time.time() if now is None else float(now)
+    summary = aggregate_serve(
+        log_dir, now=now, suspect_factor=suspect_factor, max_timeline=1,
+        tail_bytes=tail_bytes,
+    )
+    views = {}
+    for proc, v in (summary.get("replicas") or {}).items():
+        step = v.get("step_s_avg")
+        views[int(proc)] = {
+            "queued": v.get("queued"),
+            "inflight": v.get("inflight"),
+            "est_step_s": (
+                float(step) if isinstance(step, (int, float)) else None
+            ),
+            "p99_ms": v.get("p99_ms"),
+            "last_beat_unix": v.get("last_unix"),
+            "beats": v.get("beats"),
+            "final": v.get("final"),
+            "suspect": v.get("suspect"),
+            "pid": v.get("pid"),
+        }
+    return views
 
 
 def find_exemplars(log_dir: str) -> list:

@@ -59,6 +59,7 @@ import os
 import signal
 import subprocess
 import sys
+import threading
 import time
 from typing import Callable, Iterator, Optional
 
@@ -458,6 +459,20 @@ class Supervisor:
       on_spawn: callback ``(attempt, popen)`` — the chaos harness's kill
         hook.
       env: extra child environment (merged over ``os.environ``).
+      serve: serve-mode chain (the PR-15 replica fleet,
+        sav_tpu/serve/fleet.py): a serving child never exits 0 on its
+        own — it serves until told to stop — so the chain's success
+        path is :meth:`request_stop` (the pool calls it, then SIGTERMs
+        the child): once a stop is requested, the NEXT child exit ends
+        the chain with outcome ``ok`` regardless of the raw code (a
+        SIGTERM-killed server is a completed serve, not a crash), and
+        its wall time is never booked as lost. Rewind-and-skip is
+        training-only and stays off this path (serving has no schedule
+        to rewind).
+      manifest_src: the child manifest the per-attempt preservation
+        copies aside (default ``<log_dir>/manifest.json``; serve
+        replicas write ``manifest-serve-r<rank>.json`` into the SHARED
+        fleet log dir, which is not this supervisor's chain dir).
       sleep / clock: injectable for tests.
 
     The supervisor itself never imports jax (the parent of an on-chip
@@ -478,6 +493,8 @@ class Supervisor:
         on_spawn: Optional[Callable] = None,
         env: Optional[dict] = None,
         skip_steps=None,
+        serve: bool = False,
+        manifest_src: Optional[str] = None,
         sleep: Callable[[float], None] = time.sleep,
         clock: Callable[[], float] = time.time,
     ):
@@ -492,6 +509,9 @@ class Supervisor:
         self.capture = capture
         self.on_spawn = on_spawn
         self.env = dict(env) if env else {}
+        self.serve = bool(serve)
+        self.manifest_src = manifest_src
+        self._stop_requested = threading.Event()
         self._sleep = sleep
         self._clock = clock
         self.child: Optional[subprocess.Popen] = None
@@ -504,6 +524,17 @@ class Supervisor:
             argv=list(child_argv),
         )
 
+    def request_stop(self) -> None:
+        """Mark the chain as deliberately stopping (serve mode's success
+        path — the pool calls this BEFORE signalling the child so the
+        resulting exit ends the chain instead of burning a restart).
+        Callable from any thread; the caller still delivers the signal."""
+        self._stop_requested.set()
+
+    @property
+    def stop_requested(self) -> bool:
+        return self._stop_requested.is_set()
+
     # ------------------------------------------------------------- internals
 
     def _attempt_dir(self) -> str:
@@ -512,9 +543,9 @@ class Supervisor:
         return path
 
     def _preserve_manifest(self, attempt: int) -> Optional[str]:
-        """Copy the attempt's manifest.json aside before the next attempt
+        """Copy the attempt's manifest aside before the next attempt
         overwrites it; returns the preserved path + parsed outcome."""
-        src = os.path.join(self.log_dir, "manifest.json")
+        src = self.manifest_src or os.path.join(self.log_dir, "manifest.json")
         if not os.path.exists(src):
             return None
         dst = os.path.join(
@@ -609,7 +640,10 @@ class Supervisor:
         )
         lost_total = 0.0
         for i, a in enumerate(self.attempts):
-            if a.get("exit_code") == EXIT_OK:
+            if a.get("exit_code") == EXIT_OK or a.get("stopped"):
+                # A requested stop (serve mode) is a completed serve,
+                # not lost wall — the replica was serving until told
+                # to exit.
                 a["lost_s"] = 0.0
                 continue
             nxt = (
@@ -752,6 +786,21 @@ class Supervisor:
                     if preserved else None
                 ),
             }
+            if self._stop_requested.is_set():
+                # Serve-mode success path: the pool asked the chain to
+                # stop, then signalled the child — whatever code the
+                # dying server returned, this is a completed serve, not
+                # a failure to restart from.
+                record["stopped"] = True
+                record["outcome"] = outcome or "ok"
+                record["restart_reason"] = None
+                self.attempts.append(record)
+                goodput = self._account()
+                self._publish(goodput)
+                self.manifest.finalize(
+                    "ok", exit_code=0, notes={"stop_requested": True}
+                )
+                return 0
             self.attempts.append(record)
             if rc == EXIT_OK:
                 goodput = self._account()
@@ -768,7 +817,9 @@ class Supervisor:
                     exit_code=EXIT_USAGE,
                 )
                 return EXIT_USAGE
-            decided = self._decide_skip(outcome, t_start)
+            decided = (
+                [] if self.serve else self._decide_skip(outcome, t_start)
+            )
             if decided:
                 self.attempts[-1]["skip_decided"] = list(decided)
             restarts_used = attempt - 1

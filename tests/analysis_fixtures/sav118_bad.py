@@ -1,0 +1,24 @@
+"""Known-bad fixture for SAV118: device syncs in the fleet router's
+admit/route/drain path — a blocking wait inside admission, a device_get
+in the replica choice, a float() pulling a device metric through
+__float__ in the completion bookkeeping, and a sync inside the
+heartbeat-view refresh."""
+import jax
+
+
+class Router:
+    def admit(self, payload, metrics):
+        metrics["queue"].block_until_ready()
+        self.jobs.append(payload)
+
+    def route(self):
+        waits = jax.device_get(self.projections)
+        return min(range(len(waits)), key=waits.__getitem__)
+
+    def note_result(self, rank, metrics):
+        self.last_latency = float(metrics["latency"])
+        self.completed += 1
+
+    def _refresh_views(self, metrics):
+        depth = metrics["queue_depth"].item()
+        self.views[0] = depth

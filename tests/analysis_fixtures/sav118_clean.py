@@ -1,0 +1,29 @@
+"""Known-clean fixture for SAV118: the nearest legitimate idioms — the
+admission projection is host arithmetic over parsed heartbeat lines,
+the replica choice compares host floats, completion bookkeeping is
+counter updates, and the view refresh folds JSON the replicas already
+wrote (the router module is stdlib-only; no device value is in reach)."""
+import json
+import time
+
+
+class Router:
+    def admit(self, payload, deadline_s):
+        # Projection over host-side heartbeat numbers only.
+        wait = min(self._projected_wait(r) for r in self.replicas)
+        if wait > deadline_s:
+            raise RuntimeError("shed")
+        self.jobs.append((payload, time.monotonic()))
+
+    def route(self):
+        # Host comparison of host floats — nothing to sync.
+        return min(self.replicas, key=self._projected_wait)
+
+    def note_result(self, rank, ok):
+        self.outstanding[rank] -= 1
+        self.completed += 1 if ok else 0
+
+    def _refresh_views(self, path):
+        with open(path) as f:
+            for line in f:
+                self.views.update(json.loads(line))

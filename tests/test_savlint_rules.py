@@ -130,6 +130,12 @@ BAD_EXPECTATIONS = {
         ("SAV117", 17),  # jsh.NamedSharding(...) — qualified spelling
         ("SAV117", 17),  # ...wrapping a jsh.PartitionSpec(...) call
     ],
+    "sav118_bad.py": [
+        ("SAV118", 11),  # .block_until_ready() in the router's admit()
+        ("SAV118", 15),  # jax.device_get in route()
+        ("SAV118", 19),  # float(metrics[...]) in note_result()
+        ("SAV118", 23),  # metrics[...].item() in _refresh_views()
+    ],
 }
 
 CLEAN_FIXTURES = [
@@ -150,6 +156,7 @@ CLEAN_FIXTURES = [
     "sav115_clean.py",
     "sav116_clean.py",
     "sav_tpu/parallel/sav117_clean.py",
+    "sav118_clean.py",
 ]
 
 

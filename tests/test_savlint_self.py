@@ -102,6 +102,23 @@ def test_serve_hot_loop_suppressions_are_the_known_set():
     assert telemetry.suppressed == []
 
 
+def test_router_hot_path_suppressions_are_zero():
+    """SAV118 (router-hot-path-sync): the fleet router's admit/route/
+    drain surface carries ZERO suppressions — every request in the
+    fleet passes through it, so a single sanctioned sync would tax the
+    whole fleet. The router and pool modules themselves lint fully
+    clean (they are stdlib-only: no device value is even reachable)."""
+    result = lint_paths([os.path.join(ROOT, "sav_tpu", "serve")], root=ROOT)
+    assert [f for f in result.findings if f.rule == "SAV118"] == []
+    assert [f for f in result.suppressed if f.rule == "SAV118"] == []
+    for module in ("router.py", "fleet.py"):
+        one = lint_paths(
+            [os.path.join(ROOT, "sav_tpu", "serve", module)], root=ROOT
+        )
+        assert one.findings == []
+        assert one.suppressed == []
+
+
 def test_adhoc_partition_spec_suppressions_are_zero():
     """SAV117 (adhoc-partition-spec): every PartitionSpec/NamedSharding
     outside sav_tpu/parallel/ derives from the SpecLayout — the rule

@@ -25,10 +25,13 @@ benches; untraced records are skipped, not zero-filled),
 ``goodput_frac`` (elastic-training goodput from supervisor manifest
 chains, higher — supervised runs only, docs/elasticity.md),
 ``p99_latency_ms`` (serving tail latency from ``tools/serve_bench.py``,
-lower), ``serve_throughput`` (serving req/s, higher) and
+lower), ``serve_throughput`` (serving req/s, higher),
 ``slo_hit_frac`` (deadline-hit fraction from the r11 serve telemetry's
 SLO tracker, higher — all present only on serving records,
-docs/serving.md). Infra failures
+docs/serving.md), and ``fleet_p99_latency_ms`` /
+``fleet_throughput`` (the r15 replica-fleet router's end-to-end tail
+latency, lower, and fleet req/s, higher — present only on
+``serve_bench --replicas`` records). Infra failures
 are *reported but never scored* — a down relay is
 not a regression (the BENCH_r05 lesson), and a history whose only deltas
 are infra failures exits clean.
@@ -108,6 +111,21 @@ METRICS = {
     # contract). Absolute floor: one point of hit rate — a flat 1.0
     # history must not flag a single 0.997 blip.
     "slo_hit_frac": (True, 0.01),
+    # Fleet serving tail latency (tools/serve_bench.py --replicas — the
+    # router-observed p99 over N engine replicas; docs/serving.md
+    # "Fleet"): lower is better. A SEPARATE metric from p99_latency_ms
+    # on purpose: one replica's tail and the fleet's tail are different
+    # SLOs with different baselines (the fleet's includes routing,
+    # reroutes, and chaos), and mixing them would poison both
+    # histories. Present only on fleet records (fleet bench lines /
+    # kind=serve_fleet manifests); everything else is skipped, not
+    # zero-filled. Absolute floor 1 ms, the p99_latency_ms rationale.
+    "fleet_p99_latency_ms": (False, 1.0),
+    # Fleet request throughput (router-completed req/s over the serving
+    # span). Higher is better — a drop with stable per-replica
+    # throughput means the ROUTER became the bottleneck (bad balancing,
+    # over-shedding). Same presence contract as fleet_p99_latency_ms.
+    "fleet_throughput": (True, 0.0),
 }
 
 EXIT_CLEAN, EXIT_REGRESSION, EXIT_USAGE = 0, 1, 2

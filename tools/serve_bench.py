@@ -22,9 +22,28 @@ A/B arms:
   --rate R           Poisson-free fixed schedule at R req/s: measures
                      latency under a target load
 
+Fleet mode (``--replicas N`` — docs/serving.md "Fleet"): spins up N
+supervised engine replicas (tools/serve_fleet.py under the PR-9
+supervisor, shared log dir + compile cache) behind the wait-aware
+:class:`~sav_tpu.serve.router.Router`, drives the SAME open-loop load
+through the router, and emits one **fleet** JSON line —
+``fleet_p99_latency_ms`` (lower-better) / ``fleet_throughput``
+(higher-better) / ``fleet_shed`` — that the regression sentinel gates
+exactly like the single-engine metrics. The chaos arm rides here:
+``--chaos-kill-rank R`` SIGKILLs that replica mid-load (after
+``--chaos-kill-at-frac`` of the requests have been offered), then the
+line must show bounded fleet p99 (rerouted, no cliff), exact
+accounting (completed + shed == offered, nothing silently lost), the
+supervisor's warm restart (``compiled_from_scratch == 0``), and the
+router folding the victim back in (the post-restart probe counts).
+``--inject-delay RANK:SECONDS`` slows one replica per batch — the
+straggler shape the router must shift load away from. The bench parent
+NEVER imports jax in fleet mode (replicas own the backend).
+
 Usage:
   python tools/serve_bench.py --model vit_ti_patch16 --requests 512
   python tools/serve_bench.py --checkpoint runs/train/ckpt --rate 200
+  python tools/serve_bench.py --replicas 2 --requests 512 --rate 100
 """
 
 from __future__ import annotations
@@ -119,6 +138,298 @@ def run(args, manifest) -> dict:
     }
 
 
+def _parse_inject_delay(spec):
+    """``"1:0.4"`` -> (rank 1, 0.4s per-batch injected delay)."""
+    if not spec:
+        return None, 0.0
+    rank, _, secs = str(spec).partition(":")
+    try:
+        return int(rank), float(secs)
+    except ValueError:
+        raise ValueError(
+            f"--inject-delay wants RANK:SECONDS, got {spec!r}"
+        ) from None
+
+
+def run_fleet(args, manifest) -> dict:
+    """Fleet mode: pool + router + open-loop load + (optional) chaos.
+
+    The bench parent stays jax-free — replicas own the backend; every
+    number here is host wall-clock accounting at the router.
+    """
+    import numpy as np
+
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    import serve_fleet as fleet_cli
+
+    from sav_tpu.serve.batcher import QueueFullError, ServeClosedError
+    from sav_tpu.serve.fleet import TcpTransport, read_endpoints
+    from sav_tpu.serve.router import Router
+    from sav_tpu.serve.telemetry import router_views
+
+    log_dir = args.log_dir
+    delay_rank, delay_s = _parse_inject_delay(args.inject_delay)
+    env_fn = None
+    if delay_rank is not None and delay_s > 0:
+        env_fn = lambda rank: (  # noqa: E731
+            {"SAV_CHAOS_SERVE_DELAY_S": str(delay_s)}
+            if rank == delay_rank else {}
+        )
+    pool = fleet_cli.build_pool(args, log_dir, env_fn=env_fn)
+    pool.start()
+    transport = TcpTransport(log_dir)
+    router = None
+    try:
+        ready = pool.wait_ready(
+            args.replica_startup_timeout, transport=transport
+        )
+        platform = next(
+            (d.get("platform") for d in ready.values() if d.get("platform")),
+            None,
+        )
+        # Seed the router's step estimate from the replicas' measured
+        # warmups (the batcher's own seed, read over the wire).
+        step_seed = 0.05
+        for doc in ready.values():
+            warm = ((doc.get("startup") or {}).get("warmup_step_s")) or {}
+            steps = [v for v in warm.values() if isinstance(v, (int, float))]
+            if steps:
+                step_seed = max(steps)
+                break
+        deadline_s = args.deadline_ms / 1e3
+        router = Router(
+            transport,
+            views_fn=lambda: router_views(log_dir),
+            max_batch=args.max_batch,
+            default_step_s=step_seed,
+            default_deadline_s=deadline_s,
+            max_inflight=args.max_queue,
+            refresh_secs=args.router_refresh_secs,
+            ranks=range(args.replicas),
+            workers=args.fleet_workers,
+            log_dir=log_dir,
+        )
+        rng = np.random.default_rng(0)
+        payloads = [
+            rng.integers(
+                0, 256, (args.image_size, args.image_size, 3),
+                dtype=np.uint8,
+            ).tobytes()
+            for _ in range(min(args.requests, 16) or 1)
+        ]
+        chaos = None
+        if args.chaos_kill_rank is not None:
+            chaos = {
+                "rank": args.chaos_kill_rank,
+                "kill_at_request": max(
+                    int(args.requests * args.chaos_kill_at_frac), 1
+                ),
+            }
+        futures = []
+        admit_rejects = 0
+        t0 = time.monotonic()
+        for i in range(args.requests):
+            if args.rate > 0:
+                due = t0 + i / args.rate
+                delay = due - time.monotonic()
+                if delay > 0:
+                    time.sleep(delay)
+            if chaos and i == chaos["kill_at_request"]:
+                pid = pool.kill(chaos["rank"])
+                chaos["killed_pid"] = pid
+                chaos["kill_unix"] = round(time.time(), 3)
+            try:
+                futures.append(router.admit(
+                    payloads[i % len(payloads)], deadline_s=deadline_s
+                ))
+            except QueueFullError:
+                admit_rejects += 1  # router books shed_admit/rejected
+        drain_deadline = time.monotonic() + args.drain_timeout
+        counts = {"completed": 0, "shed": 0, "closed": 0, "errors": 0}
+        for future in futures:
+            try:
+                future.result(
+                    timeout=max(drain_deadline - time.monotonic(), 0.1)
+                )
+                counts["completed"] += 1
+            except ServeClosedError:
+                counts["closed"] += 1
+            except QueueFullError:  # RouterShedError subclasses it
+                counts["shed"] += 1
+            except Exception:  # noqa: BLE001 — app error or stuck future
+                counts["errors"] += 1
+        # Fleet headline SNAPSHOT before any probe traffic: the probe
+        # burst is fold-back proof, not measurement — its latencies and
+        # sheds must not contaminate the scored fleet numbers.
+        summary = router.summary()
+        # ---- chaos: wait for the supervisor to bring the victim back
+        # (new pid, warm cache), then prove the router folds it in.
+        probe_routed = None
+        if chaos and chaos.get("killed_pid"):
+            victim = chaos["rank"]
+            rec_deadline = time.monotonic() + args.chaos_recovery_timeout
+            while time.monotonic() < rec_deadline:
+                doc = read_endpoints(log_dir).get(victim)
+                if (
+                    doc is not None
+                    and doc.get("pid") != chaos["killed_pid"]
+                ):
+                    try:
+                        transport.invalidate(victim)
+                        ping = transport.ping(victim)
+                        chaos["restored_unix"] = round(time.time(), 3)
+                        chaos["outage_s"] = round(
+                            chaos["restored_unix"] - chaos["kill_unix"], 3
+                        )
+                        chaos["restart_startup"] = ping.get("startup")
+                        break
+                    except Exception:  # noqa: BLE001 — still warming
+                        pass
+                time.sleep(0.25)
+            # Fold-back proof: once the victim heartbeats again the
+            # router resumes routing to it — flood a probe burst and
+            # count where it lands.
+            if chaos.get("restored_unix") and args.probe_requests > 0:
+                active_deadline = time.monotonic() + max(
+                    args.heartbeat_secs * 20, 10.0
+                )
+                while time.monotonic() < active_deadline:
+                    router.refresh()
+                    state = router.stats()["replicas"].get(str(victim), {})
+                    if state.get("state") == "active":
+                        break
+                    time.sleep(0.2)
+                base = {
+                    rank: v["routed"]
+                    for rank, v in router.stats()["replicas"].items()
+                }
+                probe_futs = []
+                # Probe deadline: generous enough to absorb a cold
+                # replica, short enough that a lone probe's batcher
+                # trickle wait (it ships at deadline - est) cannot
+                # stall the bench for the full serving deadline.
+                probe_deadline_s = max(min(deadline_s, 2.0), 1.0)
+                for i in range(args.probe_requests):
+                    try:
+                        probe_futs.append(router.admit(
+                            payloads[i % len(payloads)],
+                            deadline_s=probe_deadline_s,
+                        ))
+                    except QueueFullError:
+                        pass
+                for future in probe_futs:
+                    try:
+                        future.result(timeout=30.0)
+                    except Exception:  # noqa: BLE001 — probe only
+                        pass
+                probe_routed = {
+                    rank: v["routed"] - base.get(rank, 0)
+                    for rank, v in router.stats()["replicas"].items()
+                }
+    finally:
+        if router is not None:
+            router.close()
+        pool.stop()
+    status = pool.status()
+    endpoints = read_endpoints(log_dir)
+    startup_warm = {
+        str(rank): ((doc.get("startup") or {}).get("compiled_from_scratch"))
+        for rank, doc in sorted(endpoints.items())
+    }
+    latency = summary.get("latency_ms") or {}
+    # Client-side ledger: every offered request resolved as exactly one
+    # of completed / shed (admission reject OR deadline shed on the
+    # future) / closed / errors. A silently-lost request would surface
+    # as a stuck future -> TimeoutError -> errors, so lost == 0 AND
+    # errors == 0 together are the chaos criterion's accounting proof.
+    shed_total = counts["shed"] + admit_rejects
+    offered = args.requests
+    accounting = {
+        "offered": offered,
+        "completed": counts["completed"],
+        "shed": shed_total,
+        "shed_at_admit": admit_rejects,
+        "closed": counts["closed"],
+        "errors": counts["errors"],
+        "lost": (
+            offered - counts["completed"] - shed_total
+            - counts["closed"] - counts["errors"]
+        ),
+    }
+    load_desc = f"{args.rate} req/s" if args.rate > 0 else "flood"
+    # Outcome honesty (the PR-10 engine __exit__ contract, fleet-wide):
+    # a run with replica app errors or stuck futures must NOT finalize
+    # ok — its partial-run p99 (computed only over the requests that
+    # happened to complete) would poison the sentinel's fleet baseline
+    # forever. Honest sheds are fine; errors are not.
+    outcome = (
+        "ok"
+        if counts["errors"] == 0 and accounting["lost"] == 0
+        else "error"
+    )
+    out = {
+        "metric": (
+            f"{args.model} fleet p99 ms ({args.replicas} replicas, "
+            f"{load_desc}, deadline {args.deadline_ms} ms, "
+            f"{args.requests} reqs)"
+        ),
+        "unit": "ms",
+        "outcome": outcome,
+        "platform": platform,
+        "replicas": args.replicas,
+        "fleet_p50_latency_ms": latency.get("p50"),
+        "fleet_p95_latency_ms": latency.get("p95"),
+        "fleet_p99_latency_ms": latency.get("p99"),
+        "fleet_throughput": summary.get("throughput_rps"),
+        "fleet_shed": shed_total,
+        "accounting": accounting,
+        "rerouted": summary["rerouted"],
+        "transport_failures": summary["transport_failures"],
+        "restarts": status["restarts"],
+        "startup_warm": startup_warm,
+        "router": summary,
+        "manifest": manifest.path,
+        "log_dir": log_dir,
+    }
+    if chaos:
+        out["chaos"] = chaos
+    if probe_routed is not None:
+        out["probe_routed"] = probe_routed
+    metrics = {
+        "fleet/replicas": float(args.replicas),
+        "fleet/restarts": float(status["restarts"]),
+        "fleet/shed": float(shed_total),
+        "fleet/rerouted": float(summary["rerouted"]),
+    }
+    # Zero-request honesty: latency/throughput absent, not zero-filled
+    # (the sentinel skips records without them — the slo_hit_frac
+    # contract).
+    if isinstance(latency.get("p99"), (int, float)):
+        metrics["fleet/p99_latency_ms"] = float(latency["p99"])
+    if isinstance(summary.get("throughput_rps"), (int, float)):
+        metrics["fleet/throughput_rps"] = float(summary["throughput_rps"])
+    manifest.note("metric", out["metric"])
+    if platform:
+        manifest.note("platform", platform)
+    manifest.note("fleet", {
+        "pool": status,
+        "accounting": accounting,
+        "chaos": chaos,
+        "probe_routed": probe_routed,
+    })
+    manifest.finalize(
+        outcome,
+        error=(
+            None if outcome == "ok"
+            else f"{counts['errors']} request error(s), "
+            f"{accounting['lost']} unaccounted — partial-run fleet "
+            "numbers must not enter the sentinel baseline"
+        ),
+        metrics=metrics,
+    )
+    return out
+
+
 def main(argv=None) -> int:
     parser = argparse.ArgumentParser(
         description=__doc__,
@@ -194,6 +505,55 @@ def main(argv=None) -> int:
         "against the 1-target error budget)",
     )
     parser.add_argument(
+        "--replicas", type=int, default=0,
+        help="fleet mode: N supervised engine replicas behind the "
+        "wait-aware router (0 = the single in-process engine); emits "
+        "the fleet_* metrics line (docs/serving.md 'Fleet')",
+    )
+    parser.add_argument(
+        "--inject-delay", default=None, metavar="RANK:SECONDS",
+        help="fleet mode: slow one replica by SECONDS per batch (the "
+        "straggler arm — the router must shift load away from it)",
+    )
+    parser.add_argument(
+        "--chaos-kill-rank", type=int, default=None,
+        help="fleet mode chaos arm: SIGKILL this replica mid-load; the "
+        "line then carries the outage, the warm-restart proof, and the "
+        "fold-back probe counts",
+    )
+    parser.add_argument(
+        "--chaos-kill-at-frac", type=float, default=0.4,
+        help="kill after this fraction of the requests has been offered",
+    )
+    parser.add_argument(
+        "--chaos-recovery-timeout", type=float, default=180.0,
+        help="seconds to wait for the supervisor to restart the victim",
+    )
+    parser.add_argument(
+        "--probe-requests", type=int, default=16,
+        help="fold-back probe burst after a chaos recovery (0 disables)",
+    )
+    parser.add_argument(
+        "--fleet-workers", type=int, default=16,
+        help="router dispatch worker threads (fleet mode)",
+    )
+    parser.add_argument(
+        "--router-refresh-secs", type=float, default=0.5,
+        help="router heartbeat-view refresh cadence (fleet mode)",
+    )
+    parser.add_argument(
+        "--replica-startup-timeout", type=float, default=600.0,
+        help="seconds to wait for every replica endpoint + ping",
+    )
+    parser.add_argument(
+        "--max-restarts", type=int, default=2,
+        help="per-replica supervisor restart budget (fleet mode)",
+    )
+    parser.add_argument(
+        "--restart-backoff", type=float, default=0.5,
+        help="per-replica supervisor backoff base seconds (fleet mode)",
+    )
+    parser.add_argument(
         "--backend-wait", type=float, default=600.0,
         help="seconds to poll for the accelerator relay before giving up "
         "(0 disables)",
@@ -206,17 +566,22 @@ def main(argv=None) -> int:
     )
     args = parser.parse_args(argv)
     if args.manifest is None:
-        args.manifest = os.path.join(
-            "runs", "serve",
-            f"manifest-serve-{time.strftime('%Y%m%d-%H%M%S')}"
-            f"-{os.getpid()}.json",
+        stamp = f"{time.strftime('%Y%m%d-%H%M%S')}-{os.getpid()}"
+        args.manifest = (
+            os.path.join("runs", "serve_fleet", f"manifest-fleet-{stamp}.json")
+            if args.replicas
+            else os.path.join("runs", "serve", f"manifest-serve-{stamp}.json")
         )
     if args.log_dir is None:
         args.log_dir = os.path.dirname(args.manifest) or "."
 
     from sav_tpu.obs.manifest import RunManifest, classify_exception
 
-    manifest = RunManifest(args.manifest, kind="serve", argv=sys.argv[1:])
+    manifest = RunManifest(
+        args.manifest,
+        kind="serve_fleet" if args.replicas else "serve",
+        argv=sys.argv[1:],
+    )
     manifest.begin()
     if args.backend_wait > 0 and "pytest" not in sys.modules:
         from sav_tpu.obs.fleet import write_probe_timeline
@@ -255,6 +620,12 @@ def main(argv=None) -> int:
             return 3
 
     try:
+        if args.replicas:
+            # Fleet mode finalizes its own manifest (kind serve_fleet)
+            # and never imports jax in this parent process.
+            out = run_fleet(args, manifest)
+            print(json.dumps(out))
+            return 0 if out.get("outcome") == "ok" else 1
         result = run(args, manifest)
     except BaseException as e:
         outcome = classify_exception(e)

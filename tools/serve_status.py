@@ -45,6 +45,7 @@ from sav_tpu.obs.fleet import (  # noqa: E402
     format_unix as _fmt_unix,
     read_autoprof_captures as autoprof_captures,
 )
+from sav_tpu.serve.router import read_router_summary  # noqa: E402
 from sav_tpu.serve.telemetry import (  # noqa: E402
     aggregate_serve,
     find_exemplars,
@@ -64,6 +65,10 @@ def gather(log_dir: str) -> dict:
         for m in find_serve_manifests(log_dir)
     ]
     summary["autoprof"] = autoprof_captures(log_dir)
+    # Fleet-router view (PR 15): the persisted router summary, when a
+    # router ran over this log dir (serve_bench --replicas / the
+    # serve_fleet pool).
+    summary["router"] = read_router_summary(log_dir)
     return summary
 
 
@@ -119,6 +124,41 @@ def render(log_dir: str, summary: dict, out) -> None:
             ),
             file=out,
         )
+    suspects = summary.get("suspects") or []
+    for s in suspects:
+        print(
+            f"SUSPECT replica {s.get('proc')}: silent "
+            f"{s.get('silent_s')}s (fleet median beat interval "
+            f"{s.get('median_interval_s')}s, last at "
+            f"{_fmt_unix(s.get('last_unix'))}, no final record) — "
+            "likely dead; the router stops routing to it",
+            file=out,
+        )
+    router = summary.get("router")
+    if router:
+        lat = router.get("latency_ms") or {}
+        print(
+            f"Router: {router.get('completed')} completed, "
+            f"{router.get('shed')} shed, {router.get('rerouted')} "
+            f"rerouted, {router.get('transport_failures')} transport "
+            f"failures — fleet p99 {lat.get('p99')} ms, "
+            f"{router.get('throughput_rps')} req/s",
+            file=out,
+        )
+        for rank, v in sorted(
+            (router.get("replicas") or {}).items(),
+            key=lambda kv: int(kv[0]),
+        ):
+            print(
+                f"  rank {rank}: {v.get('state')}, routed "
+                f"{v.get('routed')}, completed {v.get('completed')}, "
+                f"failures {v.get('failures')}"
+                + (
+                    f" ({v.get('down_reason')})"
+                    if v.get("down_reason") else ""
+                ),
+                file=out,
+            )
     timeline = summary.get("timeline") or []
     if timeline:
         t0 = timeline[0].get("t") or 0.0

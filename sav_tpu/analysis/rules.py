@@ -1286,6 +1286,56 @@ class RouterHotPathSync(Rule):
                 )
 
 
+class RouterTraceHotPathSync(Rule):
+    """Host sync in the fleet router's TRACING surface (ISSUE 16).
+
+    The distributed-tracing layer grew the router new per-request hot
+    functions: ``_dispatch()`` (the worker loop that stamps
+    route_selected/connect/sent/reply/completed and the terminal
+    shed/failed spans), ``_route_with_waits()`` (the candidate-wait
+    table every route decision records), ``_observe_completion()`` (the
+    span-ring/window fold that runs once per terminal request), and
+    ``router_beat()`` (the kind=router heartbeat snapshot). Every value
+    they touch is host-side by construction — monotonic clock stamps,
+    parsed heartbeat JSON, the router's own counters — and the whole
+    point of the ≤100µs per-request stamp budget is that OBSERVING a
+    request must not slow it: a ``device_get`` / ``block_until_ready``
+    / ``.item()`` / device-``float()`` in any of these would serialize
+    every request in the fleet behind a pipeline drain, turning the
+    telemetry into the regression it exists to catch. Deliberately
+    DISJOINT from SAV118's set (admit/route/note_result/_refresh_views/
+    drain/resume) — same module, different surface, so a finding names
+    the layer that actually regressed.
+    """
+
+    id = "SAV119"
+    name = "router-trace-hot-path-sync"
+    severity = "error"
+    hint = (
+        "keep the router's tracing surface host-only (stamps are "
+        "monotonic clock reads; the span ring and windows hold plain "
+        "floats — no device value belongs in reach); if a sync here "
+        "is truly intentional, pragma it with a justification"
+    )
+
+    # The router's per-request trace surface. Deliberately DISJOINT
+    # from SAV101's HOT_FUNCTIONS and the SAV111/SAV112/SAV115/SAV116/
+    # SAV118 sets (overlap would double-report the same call).
+    TRACE_FUNCTIONS = frozenset(
+        {"_dispatch", "_route_with_waits", "_observe_completion",
+         "router_beat"}
+    )
+
+    def check(self, module):
+        for fn in module.functions:
+            if fn.name in self.TRACE_FUNCTIONS:
+                yield from _metrics_sync_findings(
+                    self, module, fn,
+                    where="router trace hot path",
+                    coda="observing a request must not slow it",
+                )
+
+
 # ---------------------------------------------------------------- SAV117
 
 
@@ -1409,6 +1459,7 @@ ALL_RULES = [
     ServeTelemetryHotPathSync(),
     AdhocPartitionSpec(),
     RouterHotPathSync(),
+    RouterTraceHotPathSync(),
 ]
 
 

@@ -130,11 +130,19 @@ class HeartbeatWriter:
         process_count: int = 1,
         clock: Callable[[], float] = time.time,
         perf: Callable[[], float] = time.perf_counter,
+        stream: Optional[str] = None,
     ):
         self.log_dir = log_dir
         self.process_index = int(process_index)
         self.process_count = int(process_count)
-        self.path = heartbeat_path(log_dir, self.process_index)
+        # ``stream`` writes a NON-process stream (``fleet/<stream>.jsonl``
+        # — e.g. the fleet router's ``router`` stream, ISSUE 16) instead
+        # of ``proc_<i>.jsonl``. read_heartbeats globs only proc_* so a
+        # named stream can never collide with the replica aggregation.
+        self.path = (
+            os.path.join(fleet_dir(log_dir), f"{stream}.jsonl")
+            if stream else heartbeat_path(log_dir, self.process_index)
+        )
         self._clock = clock
         self._perf = perf
         # Training thread (beat/close) vs watchdog-side events share the
@@ -246,7 +254,7 @@ class HeartbeatWriter:
         finally:
             self._lock.release()
 
-    def serve_beat(self, payload: dict) -> bool:
+    def serve_beat(self, payload: dict, *, kind: str = "serve") -> bool:
         """Append one ``kind=serve`` heartbeat line (the serving
         engine's time-cadenced stream, sav_tpu/serve/telemetry.py —
         serving has no step boundary, so these carry a windowed
@@ -255,11 +263,14 @@ class HeartbeatWriter:
         same bounded-lock discipline — a wedged writer drops the beat,
         never blocks serving. Returns True iff the line was appended,
         so callers' beat counters match the lines actually on disk
-        (a dropped or post-close beat must not inflate them)."""
+        (a dropped or post-close beat must not inflate them).
+        ``kind`` widens the stream vocabulary: the fleet router beats
+        with ``kind="router"`` on its own ``fleet/router.jsonl`` stream
+        (ISSUE 16) through this same bounded-lock body."""
         t0 = self._perf()
         record: dict = {
             "schema": FLEET_SCHEMA,
-            "kind": "serve",
+            "kind": kind,
             "proc": self.process_index,
             "procs": self.process_count,
             "t": round(float(self._clock()), 3),
@@ -433,6 +444,39 @@ def read_heartbeats(
             continue
         out[proc] = records
     return out
+
+
+def read_router_beats(
+    log_dir: str, *, tail_bytes: Optional[int] = None
+) -> list[dict]:
+    """Load the fleet router's ``fleet/router.jsonl`` heartbeat stream
+    (``kind=router`` lines, ISSUE 16) with the same torn-line and
+    tail-bound discipline as :func:`read_heartbeats`. The router is one
+    process per fleet, so this returns a flat list, newest last."""
+    path = os.path.join(fleet_dir(log_dir), "router.jsonl")
+    records: list[dict] = []
+    try:
+        with open(path, "rb") as f:
+            if tail_bytes is not None:
+                f.seek(0, os.SEEK_END)
+                size = f.tell()
+                start = max(size - int(tail_bytes), 0)
+                f.seek(start)
+                if start > 0:
+                    f.readline()  # drop the partial first line
+            for raw in f:
+                line = raw.decode("utf-8", "replace").strip()
+                if not line:
+                    continue
+                try:
+                    doc = json.loads(line)
+                except json.JSONDecodeError:
+                    continue  # torn tail of a killed router
+                if isinstance(doc, dict) and doc.get("kind") == "router":
+                    records.append(doc)
+    except OSError:
+        pass
+    return records
 
 
 def read_probe_timeline(log_dir: str) -> list[dict]:

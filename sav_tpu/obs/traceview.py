@@ -549,6 +549,355 @@ def request_spans(events: list[dict]) -> dict[int, dict]:
     return out
 
 
+# ----------------------------------------------------------- fleet merge
+
+#: The merged fleet-walk vocabulary (ISSUE 16) — one contiguous
+#: router→replica→router chain per request. ``depad`` covers the
+#: replica's whole post-device tail (depad + deliver); a request whose
+#: replica export is missing degrades to the router-only chain
+#: (``replica_wait`` stays opaque) — never dropped.
+FLEET_STAGES = (
+    "router_queue",    # router: admit -> route_selected
+    "route",           # router: route_selected -> connect
+    "transport_send",  # connect -> replica admission (clock-shifted)
+    "replica_queue",   # replica: submit -> device dispatch
+    "device",          # replica: the batch step itself
+    "depad",           # replica: device done -> reply written
+    "transport_reply", # replica done (shifted) -> router completed
+)
+
+#: Router-only degradation chain: the replica decomposition collapses
+#: into the opaque ``replica_wait`` span the router measured itself.
+FLEET_STAGES_ROUTER_ONLY = (
+    "router_queue", "route", "transport_send", "replica_wait",
+    "transport_reply",
+)
+
+FLEET_TRACE_SCHEMA = 1
+
+
+def _span_bounds(events: list[dict]) -> dict:
+    """Per-request interval bounds from one export's chrome events:
+    ``{rid: {"at": {name: (start_us, end_us)}, "args": {...}}}`` (first
+    occurrence of a name wins, matching ``intervals()``' first-stamp
+    rule)."""
+    out: dict = {}
+    for e in events:
+        args = e.get("args") or {}
+        if e.get("ph") != "X" or "request" not in args:
+            continue
+        rid = args["request"]
+        view = out.setdefault(rid, {"at": {}, "args": {}})
+        name = e.get("name", "?")
+        ts = float(e.get("ts", 0.0))
+        if name not in view["at"]:
+            view["at"][name] = (ts, ts + float(e.get("dur", 0.0)))
+        for k, v in args.items():
+            if k != "request" and v is not None:
+                view["args"].setdefault(k, v)
+    return out
+
+
+def _replica_boundaries(at: dict) -> Optional[dict]:
+    """The four replica instants the merge needs, from the replica's
+    interval bounds (its own clock, µs): ``submit`` (admission start),
+    ``dispatched`` / ``executed`` (device bounds), ``completed`` (end
+    of the last present tail interval). None when the export lacks the
+    device span — a torn record degrades to router-only."""
+    if "admission" not in at or "device" not in at:
+        return None
+    completed = at["device"][1]
+    for tail in ("depad", "deliver"):
+        if tail in at:
+            completed = max(completed, at[tail][1])
+    return {
+        "submit": at["admission"][0],
+        "dispatched": at["device"][0],
+        "executed": at["device"][1],
+        "completed": completed,
+    }
+
+
+def _estimate_offset(pairs: list[tuple]) -> Optional[dict]:
+    """Per-replica clock offset (replica clock + offset = router clock)
+    from ``(sent, reply, r_submit, r_completed)`` handshake tuples (µs).
+
+    Causality bounds each request: the replica admitted AFTER the router
+    sent (``offset >= sent - r_submit``) and the router saw the reply
+    AFTER the replica finished (``offset <= reply - r_completed``).
+    Intersecting all requests' bounds gives an interval; its midpoint is
+    the estimate and its half-width the HONEST skew bound stamped into
+    the merged output. An empty intersection (stamp jitter beyond the
+    physics) falls back to the median of per-request midpoints with the
+    violation size as the bound.
+    """
+    lbs = [s - rs for s, _, rs, _ in pairs]
+    ubs = [r - rc for _, r, _, rc in pairs]
+    if not lbs:
+        return None
+    lb, ub = max(lbs), min(ubs)
+    if lb <= ub:
+        return {
+            "offset_us": (lb + ub) / 2.0,
+            "skew_us": (ub - lb) / 2.0,
+            "pairs": len(pairs),
+        }
+    mids = sorted(
+        ((s - rs) + (r - rc)) / 2.0 for s, r, rs, rc in pairs
+    )
+    return {
+        "offset_us": mids[len(mids) // 2],
+        "skew_us": (lb - ub) / 2.0,
+        "pairs": len(pairs),
+    }
+
+
+def fleet_request_spans(log_dir: str) -> dict:
+    """The offline fleet-trace joiner (ISSUE 16 tentpole, part 2).
+
+    Reads the router's span-ring export
+    (``serve_traces/requests_router.trace.json.gz``) plus every replica
+    export (``serve_traces/requests_proc<i>.trace.json.gz``), estimates
+    each replica's clock offset from the per-request handshake pairs
+    (:func:`_estimate_offset` — bounded-skew midpoint), and merges each
+    request into ONE contiguous router→replica→router chain in the
+    :data:`FLEET_STAGES` vocabulary. Requests whose replica record is
+    missing or torn keep the router-only chain
+    (:data:`FLEET_STAGES_ROUTER_ONLY`, ``router_only=True``) — a
+    request is NEVER dropped for a lost replica export.
+
+    Returns ``{"schema", "router_export", "replicas": {proc:
+    {"offset_ms", "skew_ms", "pairs"}}, "requests": {rid: {...}}}`` —
+    empty ``requests`` when there is no router export. Stdlib-only like
+    the rest of this module: runs against rsynced logs on a laptop.
+    """
+    out: dict = {
+        "schema": FLEET_TRACE_SCHEMA,
+        "router_export": None,
+        "replicas": {},
+        "requests": {},
+    }
+    router_path = os.path.join(
+        log_dir, "serve_traces", "requests_router.trace.json.gz"
+    )
+    if not os.path.isfile(router_path):
+        return out
+    try:
+        router = _span_bounds(load_trace(router_path))
+    except (OSError, json.JSONDecodeError, EOFError):
+        return out
+    out["router_export"] = router_path
+    # Replica exports: proc index from the filename; a torn file is a
+    # degraded (router-only) merge for its requests, not a failure.
+    replica: dict[int, dict] = {}
+    for path in sorted(glob.glob(os.path.join(
+        log_dir, "serve_traces", "requests_proc*.trace.json.gz"
+    ))):
+        m = re.search(r"requests_proc(\d+)\.trace\.json\.gz$",
+                      os.path.basename(path))
+        if not m:
+            continue
+        try:
+            replica[int(m.group(1))] = _span_bounds(load_trace(path))
+        except (OSError, json.JSONDecodeError, EOFError):
+            continue
+    # Clock offsets: pair each completed router record with its final
+    # replica's record (args["rank"] names the replica that replied).
+    offsets: dict[int, Optional[dict]] = {}
+    for proc, bounds in sorted(replica.items()):
+        pairs = []
+        for rid, rview in router.items():
+            if rview["args"].get("rank") != proc:
+                continue
+            if rview["args"].get("outcome") not in (None, "completed"):
+                continue
+            at = rview["at"]
+            if "replica_wait" not in at:
+                continue
+            rep = bounds.get(rid)
+            rb = _replica_boundaries(rep["at"]) if rep else None
+            if rb is None:
+                continue
+            sent, reply = at["replica_wait"]
+            pairs.append((sent, reply, rb["submit"], rb["completed"]))
+        est = _estimate_offset(pairs)
+        offsets[proc] = est
+        if est is not None:
+            out["replicas"][proc] = {
+                "offset_ms": round(est["offset_us"] / 1e3, 3),
+                "skew_ms": round(est["skew_us"] / 1e3, 3),
+                "pairs": est["pairs"],
+            }
+    # Merge each router record.
+    for rid, rview in sorted(router.items(), key=lambda kv: str(kv[0])):
+        at = rview["at"]
+        args = rview["args"]
+        rank = args.get("rank")
+        if "router_queue" not in at or "replica_wait" not in at:
+            # Shed/failed before the exchange: no cross-process walk to
+            # merge, but NEVER drop the request — keep whatever router
+            # spans exist (admission, maybe router_queue/route).
+            stages = sorted(
+                ((name, round(b[0] / 1e3, 3),
+                  round((b[1] - b[0]) / 1e3, 3))
+                 for name, b in at.items()),
+                key=lambda s: s[1],
+            )
+            out["requests"][rid] = {
+                "rank": rank,
+                "outcome": args.get("outcome"),
+                "deadline_ms": args.get("deadline_ms"),
+                "overrun_ms": args.get("overrun_ms"),
+                "router_only": True,
+                "skew_ms": None,
+                "stages": stages,
+                "total_ms": round(
+                    (max(b[1] for b in at.values())
+                     - min(b[0] for b in at.values())) / 1e3, 3
+                ) if at else 0.0,
+                "dominant_stage": (
+                    max(stages, key=lambda s: s[2])[0] if stages else None
+                ),
+            }
+            continue
+        admit = at["router_queue"][0]
+        selected = at["router_queue"][1]
+        connect = at["route"][1] if "route" in at else selected
+        sent, reply = at["replica_wait"]
+        completed = (
+            at["deliver"][1] if "deliver" in at else reply
+        )
+        est = offsets.get(rank) if rank is not None else None
+        rep = replica.get(rank, {}).get(rid) if rank is not None else None
+        rb = _replica_boundaries(rep["at"]) if rep else None
+        entry = {
+            "rank": rank,
+            "outcome": args.get("outcome"),
+            "deadline_ms": args.get("deadline_ms"),
+            "overrun_ms": args.get("overrun_ms"),
+            "router_only": rb is None or est is None,
+            "skew_ms": (
+                round(est["skew_us"] / 1e3, 3) if est is not None else None
+            ),
+        }
+        if rb is None or est is None:
+            cuts = [admit, selected, connect, sent, reply, completed]
+            names = FLEET_STAGES_ROUTER_ONLY
+        else:
+            off = est["offset_us"]
+            cuts = [admit, selected, connect,
+                    rb["submit"] + off, rb["dispatched"] + off,
+                    rb["executed"] + off, rb["completed"] + off,
+                    completed]
+            names = FLEET_STAGES
+        # Contiguity by construction: clamp each boundary to the one
+        # before it (a ±skew shift may nudge a replica instant past its
+        # neighbour; the chain must stay monotone).
+        for i in range(1, len(cuts)):
+            cuts[i] = max(cuts[i], cuts[i - 1])
+        stages = [
+            (name, round(cuts[i] / 1e3, 3),
+             round((cuts[i + 1] - cuts[i]) / 1e3, 3))
+            for i, name in enumerate(names)
+        ]
+        entry["stages"] = stages
+        entry["total_ms"] = round((cuts[-1] - cuts[0]) / 1e3, 3)
+        entry["dominant_stage"] = (
+            max(stages, key=lambda s: s[2])[0] if stages else None
+        )
+        out["requests"][rid] = entry
+    return out
+
+
+def write_fleet_trace(log_dir: str) -> Optional[str]:
+    """Persist the merged fleet walk as ONE chrome trace —
+    ``serve_traces/fleet.trace.json.gz`` — readable by every existing
+    trace consumer (``trace_report``, :func:`request_spans`). Returns
+    the path, or None when there was nothing to merge (telemetry
+    discipline: never raises)."""
+    merged = fleet_request_spans(log_dir)
+    if not merged["requests"]:
+        return None
+    events = [{
+        "ph": "M", "pid": 1, "name": "process_name",
+        "args": {"name": "Fleet Requests"},
+    }]
+    for rid, entry in merged["requests"].items():
+        for name, start_ms, dur_ms in entry["stages"]:
+            events.append({
+                "ph": "X", "pid": 1, "tid": rid, "name": name,
+                "ts": round(start_ms * 1e3, 1),
+                "dur": round(dur_ms * 1e3, 1),
+                "args": {
+                    "request": rid,
+                    "rank": entry["rank"],
+                    "outcome": entry["outcome"],
+                    "router_only": entry["router_only"],
+                    "skew_ms": entry["skew_ms"],
+                    "deadline_ms": entry["deadline_ms"],
+                    "overrun_ms": entry["overrun_ms"],
+                },
+            })
+    path = os.path.join(log_dir, "serve_traces", "fleet.trace.json.gz")
+    try:
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        tmp = f"{path}.tmp.{os.getpid()}"
+        with gzip.open(tmp, "wt") as f:
+            json.dump({"traceEvents": events}, f)
+        os.replace(tmp, path)
+        return path
+    except OSError:
+        return None
+
+
+def write_fleet_exemplars(
+    log_dir: str, *, max_exemplars: int = 8
+) -> list[str]:
+    """Dump the slowest merged requests as fleet exemplars —
+    ``serve_traces/slow_fleet_<seq>_req<rid>.json`` with the full
+    cross-process walk — under the PR-11 budget discipline (a bounded
+    count, slowest first; ``telemetry.find_exemplars``' ``slow_*.json``
+    glob picks them up next to the replica-local ones)."""
+    merged = fleet_request_spans(log_dir)
+    ranked = sorted(
+        merged["requests"].items(),
+        key=lambda kv: kv[1]["total_ms"], reverse=True,
+    )[:max(int(max_exemplars), 0)]
+    written = []
+    for seq, (rid, entry) in enumerate(ranked):
+        doc = {
+            "fleet": True,
+            "rid": rid,
+            "latency_ms": entry["total_ms"],
+            "deadline_ms": entry["deadline_ms"],
+            "overrun_ms": entry["overrun_ms"],
+            "rank": entry["rank"],
+            "outcome": entry["outcome"],
+            "router_only": entry["router_only"],
+            "skew_ms": entry["skew_ms"],
+            "dominant_stage": entry["dominant_stage"],
+            "stages_ms": {
+                name: dur for name, _, dur in entry["stages"]
+            },
+            "walk": [list(s) for s in entry["stages"]],
+        }
+        safe_rid = re.sub(r"[^\w.\-]", "_", str(rid))
+        path = os.path.join(
+            log_dir, "serve_traces",
+            f"slow_fleet_{seq:04d}_req{safe_rid}.json",
+        )
+        try:
+            os.makedirs(os.path.dirname(path), exist_ok=True)
+            tmp = f"{path}.tmp.{os.getpid()}"
+            with open(tmp, "w") as f:
+                json.dump(doc, f, indent=2, default=str)
+            os.replace(tmp, path)
+            written.append(path)
+        except OSError:
+            continue
+    return written
+
+
 # --------------------------------------------------------------- summaries
 
 TRACEVIEW_SCHEMA = 1

@@ -725,7 +725,13 @@ class ServeEngine:
                 step_s=step_s,
             )
 
-    def submit(self, image: np.ndarray, *, deadline_ms: Optional[float] = None):
+    def submit(
+        self,
+        image: np.ndarray,
+        *,
+        deadline_ms: Optional[float] = None,
+        trace_id=None,
+    ):
         """Admit one preprocessed uint8 request; returns its future.
 
         ``image`` must be ``[image_size, image_size, 3]`` uint8 (use
@@ -733,6 +739,11 @@ class ServeEngine:
         :meth:`submit_raw` for raw decoded images). Raises
         :class:`~sav_tpu.serve.batcher.QueueFullError` on an admission
         reject (counted on the ledger).
+
+        ``trace_id`` (ISSUE 16): a router-propagated fleet trace id —
+        ``begin_trace`` ADOPTS it instead of minting a replica-local
+        one, so this replica's spans join the fleet-wide trace.
+        Replica-local serving (no id) is unchanged.
         """
         if not self._started or self._stopped:
             raise ServeClosedError("engine is not serving (start() first)")
@@ -749,7 +760,7 @@ class ServeEngine:
             else self.config.deadline_ms / 1e3
         )
         trace = (
-            self._telemetry.begin_trace(deadline_s)
+            self._telemetry.begin_trace(deadline_s, rid=trace_id)
             if self._telemetry is not None else None
         )
         try:

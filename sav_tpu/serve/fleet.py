@@ -197,8 +197,17 @@ class TcpTransport:
         with self._lock:
             self._cache.pop(rank, None)
 
+    #: Router trace seam: send() accepts ``stamp_fn`` and stamps
+    #: ``connect``/``sent`` at the real socket instants (ISSUE 16).
+    supports_stamps = True
+
     def _exchange(
-        self, rank: int, header: dict, payload: bytes, timeout_s: float
+        self,
+        rank: int,
+        header: dict,
+        payload: bytes,
+        timeout_s: float,
+        stamp_fn=None,
     ) -> dict:
         host, port = self.resolve(rank)
         try:
@@ -206,6 +215,8 @@ class TcpTransport:
                 (host, port),
                 timeout=min(self.connect_timeout_s, max(timeout_s, 0.05)),
             ) as sock:
+                if stamp_fn is not None:
+                    stamp_fn("connect")
                 # Reply timeout = deadline remainder + grace: a dead
                 # process fails the CONNECT instantly (refused/reset);
                 # a reply is allowed the same past-deadline slack the
@@ -215,6 +226,8 @@ class TcpTransport:
                 sock.sendall(
                     json.dumps(header).encode("utf-8") + b"\n" + payload
                 )
+                if stamp_fn is not None:
+                    stamp_fn("sent")
                 chunks = []
                 while True:
                     chunk = sock.recv(65536)
@@ -252,14 +265,27 @@ class TcpTransport:
         return reply
 
     def send(
-        self, rank: int, payload: bytes, meta: dict, timeout_s: float
+        self,
+        rank: int,
+        payload: bytes,
+        meta: dict,
+        timeout_s: float,
+        stamp_fn=None,
     ) -> dict:
-        """One inference exchange (the Router's dispatch wire)."""
+        """One inference exchange (the Router's dispatch wire).
+        ``stamp_fn`` (optional, ISSUE 16) is called with ``"connect"``
+        when the socket opens and ``"sent"`` when the request bytes are
+        handed off — the router's trace stamps at the real wire
+        instants. The trace id itself rides the header: the router puts
+        it in ``meta["trace"]`` and the replica server hands it to
+        ``engine.submit``."""
         header = dict(meta or {})
         header["op"] = "infer"
         header["nbytes"] = len(payload)
         header.setdefault("deadline_ms", round(timeout_s * 1e3, 3))
-        return self._exchange(rank, header, bytes(payload), timeout_s)
+        return self._exchange(
+            rank, header, bytes(payload), timeout_s, stamp_fn=stamp_fn
+        )
 
     def ping(self, rank: int, timeout_s: float = 5.0) -> dict:
         """Health probe: the replica answers with its rank/pid/platform

@@ -41,14 +41,34 @@ Replica lifecycle the router tracks (docs/serving.md "Fleet"):
   heartbeat newer than the down mark (the PR-9 supervisor restarts the
   process; its first beat folds it back in).
 
+Distributed tracing (ISSUE 16, docs/serving.md "Distributed
+tracing"): the router mints a globally unique trace id per admitted
+request (``r<pid>-<seq>``) and stamps its own lifecycle with the same
+stdlib :func:`~sav_tpu.serve.telemetry.stamp` machinery the replicas
+use — ``submit -> admit -> route_selected -> connect -> sent -> reply
+-> completed`` in the ROUTER's clock domain, one sub-span per
+reroute/retry attempt, and honest terminal stamps for shed/failed.
+The id rides the wire header (``meta["trace"]``); the replica's
+``begin_trace`` adopts it, and the offline merge
+(:func:`sav_tpu.obs.traceview.fleet_request_spans`) joins the two clock
+domains into one contiguous router->replica->router chain per request.
+Completed router traces land in a bounded
+:class:`~sav_tpu.serve.telemetry.SpanRing` exported at close; live
+per-stage windows feed ``kind=router`` heartbeats on the PR-7
+substrate (``fleet/router.jsonl``).
+
 savlint SAV118 (``router-hot-path-sync``) owns this module's hot
 functions (``admit`` / ``route`` / ``note_result`` / ``_refresh_views``
-/ ``drain`` / ``resume``): a device sync anywhere in the routing path
-would serialize every request in the fleet behind one pipeline drain.
+/ ``drain`` / ``resume``), and SAV119 (``router-trace-hot-path-sync``)
+owns the trace surface it grew (``_dispatch`` / ``_route_with_waits``
+/ ``_observe_completion`` / ``router_beat``): a device sync anywhere
+in the routing or tracing path would serialize every request in the
+fleet behind one pipeline drain.
 """
 
 from __future__ import annotations
 
+import itertools
 import json
 import os
 import queue as _queue_mod
@@ -56,15 +76,29 @@ import threading
 import time
 from typing import Any, Callable, Optional
 
-from sav_tpu.obs.fleet import _loo_scores
+from sav_tpu.obs.fleet import HeartbeatWriter, _loo_scores
 from sav_tpu.serve.batcher import (
     DeadlineInfeasibleError,
     QueueFullError,
     ServeClosedError,
     ServeFuture,
 )
+from sav_tpu.serve.telemetry import (
+    ROUTER_INTERVALS,
+    RequestTrace,
+    SlidingWindow,
+    SpanRing,
+    dominant_stage,
+    intervals,
+    stamp,
+    write_request_trace,
+)
 
 ROUTER_SCHEMA = 1
+
+
+def _round3(v: Optional[float]) -> Optional[float]:
+    return round(v, 3) if isinstance(v, (int, float)) else None
 
 #: Replica states (docs/serving.md "Fleet" state table).
 ACTIVE = "active"
@@ -171,7 +205,10 @@ class _Replica:
 
 
 class _Job:
-    __slots__ = ("jid", "payload", "meta", "deadline_t", "admit_t", "future")
+    __slots__ = (
+        "jid", "payload", "meta", "deadline_t", "admit_t", "future",
+        "trace", "attempts", "waits",
+    )
 
     def __init__(self, jid, payload, meta, deadline_t, admit_t, future):
         self.jid = jid
@@ -180,6 +217,12 @@ class _Job:
         self.deadline_t = deadline_t
         self.admit_t = admit_t
         self.future = future
+        # Tracing: the per-request RequestTrace (router clock domain),
+        # the per-attempt sub-span ledger, and the candidate projected
+        # waits the first route decision saw (ms, keyed by rank).
+        self.trace: Optional[RequestTrace] = None
+        self.attempts: list = []
+        self.waits: Optional[dict] = None
 
 
 _STOP = object()
@@ -223,7 +266,21 @@ class Router:
         drivers).
       clock / wall_clock / sleep: injectable for fake-clock tests.
       log_dir: when set, ``close()`` writes the router summary to
-        ``<log_dir>/fleet/router.json`` for ``serve_status``.
+        ``<log_dir>/fleet/router.json`` for ``serve_status``, exports
+        the router span ring to
+        ``<log_dir>/serve_traces/requests_router.trace.json.gz``, and
+        (with ``heartbeat_secs > 0``) streams ``kind=router``
+        heartbeats to ``<log_dir>/fleet/router.jsonl``.
+      trace_depth: span-ring depth for completed/terminal request
+        traces (the PR-11 bound — old spans roll off, admission never
+        blocks on telemetry).
+      heartbeat_secs: ``kind=router`` heartbeat cadence; ``0`` (the
+        default) disables the heartbeat thread.
+      window_s: sliding-window span for the live latency / per-stage
+        attribution the heartbeats and mid-run ``summary()`` carry.
+      perf: the overhead meter (``time.perf_counter``) — tracing cost
+        is self-accounted exactly like the PR-11 engine telemetry and
+        surfaced as ``router_overhead_ms`` per completed request.
     """
 
     _POLL_S = 0.02  # no-routable-replica retry cadence inside dispatch
@@ -248,6 +305,10 @@ class Router:
         wall_clock: Callable[[], float] = time.time,
         sleep: Callable[[float], None] = time.sleep,
         log_dir: Optional[str] = None,
+        trace_depth: int = 256,
+        heartbeat_secs: float = 0.0,
+        window_s: float = 30.0,
+        perf: Callable[[], float] = time.perf_counter,
     ):
         if max_inflight < 1:
             raise ValueError(f"max_inflight must be >= 1, got {max_inflight}")
@@ -287,6 +348,27 @@ class Router:
         self._rerouted = 0
         self._transport_failures = 0
         self._errors = 0
+        self._down_flaps = 0
+        # Tracing state (ISSUE 16): globally unique ids (r<pid>-<seq>),
+        # a bounded span ring of terminal traces, live latency /
+        # per-stage sliding windows, and the self-accounted overhead
+        # meter behind router_overhead_ms.
+        self._pid = os.getpid()
+        self._trace_seq = itertools.count()
+        self._perf = perf
+        self.window_s = float(window_s)
+        self._ring = SpanRing(depth=int(trace_depth))
+        self._lat_window = SlidingWindow(self.window_s, clock=clock)
+        self._stage_windows: dict[str, SlidingWindow] = {}
+        self._overhead_s = 0.0
+        self.heartbeat_secs = float(heartbeat_secs)
+        self._hb_writer = None
+        self._hb_thread = None
+        if log_dir:
+            self._hb_writer = HeartbeatWriter(
+                log_dir, process_index=0, stream="router",
+                clock=wall_clock,
+            )
         for rank in (ranks or ()):
             self._replicas[int(rank)] = _Replica(int(rank))
         self._refresh_views()  # seed the table before the first admit
@@ -298,6 +380,11 @@ class Router:
             )
             t.start()
             self._workers.append(t)
+        if self._hb_writer is not None and self.heartbeat_secs > 0:
+            self._hb_thread = threading.Thread(
+                target=self._hb_loop, name="router-heartbeat", daemon=True
+            )
+            self._hb_thread.start()
 
     # ----------------------------------------------------------- admission
 
@@ -324,6 +411,7 @@ class Router:
         )
         if deadline_s <= 0:
             raise ValueError(f"deadline_s must be > 0, got {deadline_s}")
+        t_entry = self._clock()  # the trace's "submit" instant
         self._maybe_refresh()
         # Capacity check, shed projection, and the inflight increment in
         # ONE critical section: a check in a separate lock acquisition
@@ -357,6 +445,15 @@ class Router:
                 self._jid, payload, dict(meta or {}),
                 now + deadline_s, now, ServeFuture(),
             )
+            # Mint the fleet-global trace id and stamp submit/admit in
+            # the router's clock domain; the id rides the wire header
+            # (meta["trace"]) so the replica's begin_trace adopts it.
+            t0 = self._perf()
+            rid = f"r{self._pid}-{next(self._trace_seq)}"
+            job.trace = RequestTrace(rid, deadline_s, t_entry)
+            stamp(job.trace, "admit", now)
+            job.meta["trace"] = rid
+            self._overhead_s += self._perf() - t0
             self._inflight_total += 1
         if self._workers:
             self._jobs.put(job)
@@ -401,17 +498,28 @@ class Router:
         rank — deterministic), or None when nothing is routable (all
         down/draining — the dispatch loop polls for recovery until the
         deadline). Host arithmetic only (SAV118)."""
+        rank, _ = self._route_with_waits()
+        return rank
+
+    def _route_with_waits(self) -> tuple:
+        """:meth:`route` plus the full candidate wait table the decision
+        saw — ``(best_rank, {rank: projected_wait_s})`` — so the trace's
+        ``route_selected`` span can carry WHY this replica won (the
+        Tail-at-Scale attribution input). Same lock discipline and host
+        arithmetic as route(); savlint SAV119 owns this body."""
         with self._lock:
             best = None
             best_wait = None
+            waits: dict = {}
             for rank in sorted(self._replicas):
                 replica = self._replicas[rank]
                 if replica.state != ACTIVE:
                     continue
                 wait = self._projected_wait(replica)
+                waits[rank] = wait
                 if best_wait is None or wait < best_wait:
                     best, best_wait = rank, wait
-            return best
+            return best, waits
 
     # ------------------------------------------------------------ dispatch
 
@@ -428,7 +536,11 @@ class Router:
         marks the replica down and REROUTES while the deadline stands
         (never silently lost); a replica-side shed retries as capacity
         frees; past the deadline the future fails with
-        :class:`RouterShedError` — the honest shed."""
+        :class:`RouterShedError` — the honest shed. Stamps the trace
+        lifecycle (route_selected/connect/sent/reply/completed plus one
+        sub-span per attempt) along the way — host stamps only, savlint
+        SAV119 owns this body."""
+        trace = job.trace
         try:
             while True:
                 if self._closed.is_set():
@@ -436,6 +548,7 @@ class Router:
                         ServeClosedError("router closed with this request "
                                          "in flight")
                     )
+                    self._observe_completion(job, rank=None, outcome="failed")
                     return
                 # Keep the view fresh on the dispatch path too: under a
                 # flood, admissions stop long before dispatch does, and
@@ -451,23 +564,60 @@ class Router:
                         "deadline (rerouted/retried until the budget ran "
                         "out) — shed, not silently dropped"
                     ))
+                    self._observe_completion(job, rank=None, outcome="shed")
                     return
-                rank = self.route()
+                rank, waits = self._route_with_waits()
                 if rank is None:
                     self._sleep(min(self._POLL_S, remaining))
                     self._maybe_refresh()
                     continue
+                t_selected = self._clock()
+                # First stamp wins in intervals() — a reroute's second
+                # route_selected leaves the original span intact; the
+                # per-attempt ledger carries the retries.
+                stamp(trace, "route_selected", t_selected)
+                if job.waits is None:
+                    job.waits = {
+                        int(r): round(w * 1e3, 3) for r, w in waits.items()
+                    }
+                attempt = {"rank": int(rank), "t_start": t_selected}
+                job.attempts.append(attempt)
                 with self._lock:
                     replica = self._replicas.get(rank)
                     if replica is None:
                         continue
                     replica.routed += 1
                     replica.sends[job.jid] = self._wall()
+                # Transport stamp seam: a stamp-aware transport (the
+                # production TcpTransport) stamps connect/sent at the
+                # real socket instants; a plain transport degrades to
+                # stamping both at the pre-send instant so the chain
+                # stays contiguous (transport_send collapses to ~0 and
+                # the whole exchange lands in replica_wait).
+                stamp_fn = None
+                if trace is not None:
+                    if getattr(self._transport, "supports_stamps", False):
+                        clock = self._clock
+                        stamp_fn = lambda name, _t=trace: (  # noqa: E731
+                            stamp(_t, name, clock())
+                        )
+                    else:
+                        t_pre = self._clock()
+                        stamp(trace, "connect", t_pre)
+                        stamp(trace, "sent", t_pre)
                 try:
-                    result = self._transport.send(
-                        rank, job.payload, job.meta, remaining
-                    )
+                    if stamp_fn is not None:
+                        result = self._transport.send(
+                            rank, job.payload, job.meta, remaining,
+                            stamp_fn=stamp_fn,
+                        )
+                    else:
+                        result = self._transport.send(
+                            rank, job.payload, job.meta, remaining
+                        )
                 except ReplicaShedError:
+                    attempt["t_end"] = self._clock()
+                    attempt["outcome"] = "replica_shed"
                     self.note_result(rank, job.jid, ok=False)
                     # The replica's own admission control is loaded:
                     # back off briefly and retry (here or elsewhere)
@@ -476,6 +626,8 @@ class Router:
                     self._maybe_refresh()
                     continue
                 except ReplicaTransportError as e:
+                    attempt["t_end"] = self._clock()
+                    attempt["outcome"] = "transport_error"
                     self.note_result(rank, job.jid, ok=False)
                     with self._lock:
                         self._transport_failures += 1
@@ -483,18 +635,29 @@ class Router:
                     self._mark_down(rank, reason=f"transport: {e}")
                     continue
                 except Exception as e:  # noqa: BLE001 — replica app error
+                    attempt["t_end"] = self._clock()
+                    attempt["outcome"] = "error"
                     self.note_result(rank, job.jid, ok=False)
                     with self._lock:
                         self._errors += 1
                     job.future.set_exception(e)
+                    self._observe_completion(job, rank=rank, outcome="failed")
                     return
                 self.note_result(rank, job.jid, ok=True)
                 now = self._clock()
+                stamp(trace, "reply", now)
+                attempt["t_end"] = now
+                attempt["outcome"] = "ok"
                 with self._lock:
                     self._completed += 1
                     self._latencies_s.append(now - job.admit_t)
                     self._last_complete_t = now
                 job.future.set_result(result)
+                stamp(trace, "completed", self._clock())
+                self._observe_completion(
+                    job, rank=rank, outcome="completed",
+                    latency_s=now - job.admit_t,
+                )
                 return
         finally:
             with self._lock:
@@ -513,6 +676,155 @@ class Router:
             else:
                 replica.failures += 1
 
+    # ------------------------------------------------------------- tracing
+
+    def _observe_completion(
+        self,
+        job: _Job,
+        *,
+        rank: Optional[int],
+        outcome: str,
+        latency_s: Optional[float] = None,
+    ) -> None:
+        """Fold one TERMINAL request (completed/shed/failed) into the
+        span ring and the live windows. Self-accounted against the
+        overhead meter (router_overhead_ms) and host-only by contract —
+        savlint SAV119 owns this body; it runs once per request on the
+        dispatch path."""
+        trace = job.trace
+        if trace is None:
+            return
+        t0 = self._perf()
+        now = self._clock()
+        if outcome != "completed":
+            # Honest terminal stamp: shed/failed traces end with their
+            # real outcome, never a fake "completed".
+            stamp(trace, outcome if outcome == "shed" else "failed", now)
+        if latency_s is None:
+            latency_s = now - job.admit_t
+        overrun_s = latency_s - trace.deadline_s
+        stages_s = intervals(trace.stamps, ROUTER_INTERVALS)
+        record = {
+            "rid": trace.rid,
+            "deadline_ms": trace.deadline_s * 1e3,
+            "latency_ms": latency_s * 1e3,
+            "overrun_ms": overrun_s * 1e3,
+            "hit": outcome == "completed" and overrun_s <= 0.0,
+            "rank": rank,
+            "outcome": outcome,
+            "attempts": [
+                {
+                    "rank": a.get("rank"),
+                    "outcome": a.get("outcome"),
+                    "ms": (
+                        round((a["t_end"] - a["t_start"]) * 1e3, 3)
+                        if "t_end" in a else None
+                    ),
+                }
+                for a in job.attempts
+            ],
+            "candidate_waits_ms": job.waits,
+            "stamps": trace.stamps,
+            "stages_ms": {k: v * 1e3 for k, v in stages_s.items()},
+            "dominant_stage": dominant_stage(stages_s),
+        }
+        with self._lock:
+            self._ring.append(record)
+            if outcome == "completed":
+                self._lat_window.observe(latency_s * 1e3, now=now)
+                for name, dur_s in stages_s.items():
+                    w = self._stage_windows.get(name)
+                    if w is None:
+                        w = self._stage_windows[name] = SlidingWindow(
+                            self.window_s, clock=self._clock
+                        )
+                    w.observe(dur_s * 1e3, now=now)
+            self._overhead_s += self._perf() - t0
+
+    def _window_snapshot(self, now: Optional[float] = None) -> dict:
+        """The live windowed view (owner must hold the lock): latency
+        percentiles, throughput over the window, and per-stage latency
+        SHARES — where the window's wall time went, the Tail-at-Scale
+        attribution the heartbeats carry."""
+        if now is None:
+            now = self._clock()
+        n = self._lat_window.count(now=now)
+        total_ms = self._lat_window.total(now=now)
+        stage_shares = {}
+        if total_ms > 0:
+            for name, w in sorted(self._stage_windows.items()):
+                stage_ms = w.total(now=now)
+                if stage_ms > 0:
+                    stage_shares[name] = round(stage_ms / total_ms, 4)
+        # Effective span: a run younger than the window must divide by
+        # the time actually served, not the full window — otherwise a
+        # 2-second flood reads as window_s worth of "throughput" and
+        # mid-run disagrees with the close-time summary (the ISSUE-16
+        # bugfix this snapshot exists for).
+        eff = self.window_s
+        if self._first_admit_t is not None:
+            eff = min(self.window_s, max(now - self._first_admit_t, 1e-9))
+        return {
+            "window_s": self.window_s,
+            "requests": n,
+            "p50_ms": _round3(self._lat_window.percentile(50.0, now=now)),
+            "p95_ms": _round3(self._lat_window.percentile(95.0, now=now)),
+            "p99_ms": _round3(self._lat_window.percentile(99.0, now=now)),
+            "throughput_rps": round(n / eff, 2) if n else 0.0,
+            "stage_shares": stage_shares,
+        }
+
+    def live(self) -> dict:
+        """The mid-run router view — counters + the windowed snapshot —
+        the SAME numbers ``summary()`` reports at close (the ISSUE-16
+        bugfix: serve_status mid-run and post-run must agree)."""
+        with self._lock:
+            now = self._clock()
+            view_age = (
+                now - self._last_refresh
+                if self._last_refresh is not None else None
+            )
+            span = None
+            if (
+                self._first_admit_t is not None
+                and self._last_complete_t is not None
+            ):
+                span = max(self._last_complete_t - self._first_admit_t, 1e-9)
+            return {
+                "completed": self._completed,
+                "throughput_rps": (
+                    round(self._completed / span, 2) if span else None
+                ),
+                "rejected": self._rejected,
+                "shed": self._shed_admit + self._shed_deadline,
+                "rerouted": self._rerouted,
+                "transport_failures": self._transport_failures,
+                "errors": self._errors,
+                "down_flaps": self._down_flaps,
+                "inflight": self._inflight_total,
+                "view_age_s": _round3(view_age),
+                "router_overhead_ms": self._overhead_ms_locked(),
+                "w": self._window_snapshot(now),
+            }
+
+    def _overhead_ms_locked(self) -> float:
+        return round(
+            self._overhead_s / max(self._completed, 1) * 1e3, 4
+        )
+
+    def router_beat(self) -> bool:
+        """Append one ``kind=router`` heartbeat to ``fleet/router.jsonl``
+        (the PR-7 substrate; bounded-lock, drop-never-block). The router
+        is a first-class fleet citizen: serve_status/fleet_status render
+        this stream next to the replicas'. SAV119 owns this body."""
+        if self._hb_writer is None:
+            return False
+        return self._hb_writer.serve_beat(self.live(), kind="router")
+
+    def _hb_loop(self) -> None:
+        while not self._closed.wait(self.heartbeat_secs):
+            self.router_beat()
+
     # ----------------------------------------------------- replica states
 
     def _mark_down(self, rank: int, *, reason: str) -> None:
@@ -523,6 +835,7 @@ class Router:
             replica.state = DOWN
             replica.down_since_unix = self._wall()
             replica.down_reason = reason
+            self._down_flaps += 1
 
     def drain(
         self, rank: int, *, reason: str = "manual", auto: bool = False
@@ -634,6 +947,7 @@ class Router:
                             "final record" if replica.final
                             else "heartbeat-silent"
                         )
+                        self._down_flaps += 1
                 elif (
                     replica.state == DOWN
                     and replica.last_beat_unix is not None
@@ -694,7 +1008,27 @@ class Router:
             self._jobs.put(_STOP)
         for t in self._workers:
             t.join(timeout=5.0)
+        if self._hb_thread is not None:
+            self._hb_thread.join(timeout=5.0)
+        if self._hb_writer is not None:
+            # One last beat with the final counters, then the stream's
+            # orderly final record.
+            self._hb_writer.serve_beat(self.live(), kind="router")
+            self._hb_writer.close()
         if self.log_dir:
+            with self._lock:
+                records = self._ring.records()
+            if records:
+                write_request_trace(
+                    os.path.join(
+                        self.log_dir, "serve_traces",
+                        "requests_router.trace.json.gz",
+                    ),
+                    records,
+                    ROUTER_INTERVALS,
+                    process_name="Fleet Router",
+                    extra_args=("rank", "outcome"),
+                )
             self.write_summary()
 
     def _fail_queued_jobs(self) -> None:
@@ -736,6 +1070,8 @@ class Router:
                 "rerouted": self._rerouted,
                 "transport_failures": self._transport_failures,
                 "errors": self._errors,
+                "down_flaps": self._down_flaps,
+                "router_overhead_ms": self._overhead_ms_locked(),
                 "inflight": self._inflight_total,
                 "replicas": {
                     str(rank): r.view()
@@ -769,6 +1105,13 @@ class Router:
                 "rerouted": self._rerouted,
                 "transport_failures": self._transport_failures,
                 "errors": self._errors,
+                "down_flaps": self._down_flaps,
+                "router_overhead_ms": self._overhead_ms_locked(),
+                "traces": {
+                    "ring": len(self._ring),
+                    "appended": self._ring.appended,
+                },
+                "window": self._window_snapshot(),
                 "latency_ms": {
                     "p50": round(percentile(lat, 50.0) * 1e3, 3) if lat else None,
                     "p95": round(percentile(lat, 95.0) * 1e3, 3) if lat else None,

@@ -110,6 +110,36 @@ INTERVALS = (
     ("deliver", "depadded", "completed"),
 )
 
+#: The fleet router's span vocabulary (ISSUE 16, docs/serving.md
+#: "Distributed tracing"), in lifecycle order. The router stamps with
+#: its OWN monotonic clock — replica stamps live in the replica's clock
+#: domain and only meet these in the offline merge
+#: (:func:`sav_tpu.obs.traceview.fleet_request_spans`), which estimates
+#: the per-replica offset from the (sent, submit)/(completed, reply)
+#: handshake pairs. Terminal stamps for requests that never complete
+#: ("shed", "failed") ride the same list but end no interval.
+ROUTER_STAGES = (
+    "submit",         # router.admit entry (request validated, job built)
+    "admit",          # admission passed (capacity + shed projection)
+    "route_selected", # a dispatch worker picked a replica
+    "connect",        # transport connection to the replica established
+    "sent",           # request bytes handed to the replica socket
+    "reply",          # the replica's reply line arrived
+    "completed",      # future resolved; the submitter can read the result
+)
+
+#: The router's per-request intervals (its own clock domain only).
+#: ``replica_wait`` is the opaque cross-process span the offline merge
+#: decomposes into replica_queue/device/depad + transport halves.
+ROUTER_INTERVALS = (
+    ("admission", "submit", "admit"),
+    ("router_queue", "admit", "route_selected"),
+    ("route", "route_selected", "connect"),
+    ("transport_send", "connect", "sent"),
+    ("replica_wait", "sent", "reply"),
+    ("deliver", "reply", "completed"),
+)
+
 
 class RequestTrace:
     """One request's span record: an append-only ``(stage, t)`` list.
@@ -135,13 +165,15 @@ def stamp(trace: Optional[RequestTrace], stage: str, t: float) -> None:
         trace.stamps.append((stage, t))
 
 
-def intervals(stamps: list) -> dict:
-    """Per-interval seconds from a stamp list (missing stages skipped)."""
+def intervals(stamps: list, defs: tuple = INTERVALS) -> dict:
+    """Per-interval seconds from a stamp list (missing stages skipped).
+    ``defs`` selects the vocabulary — the replica's :data:`INTERVALS`
+    by default, :data:`ROUTER_INTERVALS` for router traces."""
     at = {}
     for name, t in stamps:
         at.setdefault(name, float(t))
     out = {}
-    for name, start, end in INTERVALS:
+    for name, start, end in defs:
         if start in at and end in at:
             out[name] = at[end] - at[start]
     return out
@@ -209,16 +241,26 @@ def trace_record(
 # -------------------------------------------------------- chrome export
 
 
-def export_chrome_trace(records: list) -> dict:
+def export_chrome_trace(
+    records: list,
+    defs: tuple = INTERVALS,
+    *,
+    process_name: str = "Serve Requests",
+    extra_args: tuple = (),
+) -> dict:
     """The span ring as chrome-trace events (one row per request,
     one "X" event per interval) — the format
     :func:`sav_tpu.obs.traceview.load_trace` /
     ``traceview.request_spans`` read, so ``tools/trace_report.py``
-    renders request timelines with the device-profile machinery."""
+    renders request timelines with the device-profile machinery.
+    ``defs`` picks the interval vocabulary; ``extra_args`` names record
+    keys copied into each event's args verbatim (the router export
+    carries ``rank``/``outcome`` so the offline merge can join the
+    replica's trace)."""
     events = [
         {
             "ph": "M", "pid": 1, "name": "process_name",
-            "args": {"name": "Serve Requests"},
+            "args": {"name": process_name},
         }
     ]
     for rec in records:
@@ -226,9 +268,26 @@ def export_chrome_trace(records: list) -> dict:
         for stage, t in rec.get("stamps", []):
             at.setdefault(stage, float(t))
         rid = rec.get("rid", 0)
-        for name, start, end in INTERVALS:
+        for name, start, end in defs:
             if start not in at or end not in at:
                 continue
+            args = {
+                "request": rid,
+                "bucket": rec.get("bucket"),
+                "deadline_ms": (
+                    round(rec["deadline_ms"], 3)
+                    if isinstance(rec.get("deadline_ms"), (int, float))
+                    else None
+                ),
+                "overrun_ms": (
+                    round(rec["overrun_ms"], 3)
+                    if isinstance(rec.get("overrun_ms"), (int, float))
+                    else None
+                ),
+            }
+            for key in extra_args:
+                if key in rec:
+                    args[key] = rec[key]
             events.append({
                 "ph": "X",
                 "pid": 1,
@@ -236,32 +295,32 @@ def export_chrome_trace(records: list) -> dict:
                 "name": name,
                 "ts": round(at[start] * 1e6, 1),
                 "dur": round((at[end] - at[start]) * 1e6, 1),
-                "args": {
-                    "request": rid,
-                    "bucket": rec.get("bucket"),
-                    "deadline_ms": (
-                        round(rec["deadline_ms"], 3)
-                        if isinstance(rec.get("deadline_ms"), (int, float))
-                        else None
-                    ),
-                    "overrun_ms": (
-                        round(rec["overrun_ms"], 3)
-                        if isinstance(rec.get("overrun_ms"), (int, float))
-                        else None
-                    ),
-                },
+                "args": args,
             })
     return {"traceEvents": events}
 
 
-def write_request_trace(path: str, records: list) -> Optional[str]:
+def write_request_trace(
+    path: str,
+    records: list,
+    defs: tuple = INTERVALS,
+    *,
+    process_name: str = "Serve Requests",
+    extra_args: tuple = (),
+) -> Optional[str]:
     """Persist the ring as ``*.trace.json.gz`` (telemetry: returns None
     instead of raising on I/O failure)."""
     try:
         os.makedirs(os.path.dirname(path), exist_ok=True)
         tmp = f"{path}.tmp.{os.getpid()}"
         with gzip.open(tmp, "wt") as f:
-            json.dump(export_chrome_trace(records), f)
+            json.dump(
+                export_chrome_trace(
+                    records, defs,
+                    process_name=process_name, extra_args=extra_args,
+                ),
+                f,
+            )
         os.replace(tmp, path)
         return path
     except OSError:
@@ -694,12 +753,20 @@ class ServeTelemetry:
 
     # ----------------------------------------------------------- tracing
 
-    def begin_trace(self, deadline_s: float) -> RequestTrace:
+    def begin_trace(self, deadline_s: float, *, rid=None) -> RequestTrace:
         """Open one request's span record (engine ``submit`` entry —
         host clock only, SAV116). Request ids come from a lock-free
         counter (itertools.count — the submit path must not contend
-        with the device loop's telemetry lock)."""
-        return RequestTrace(next(self._rid), deadline_s, self.clock())
+        with the device loop's telemetry lock) unless the caller
+        propagates one: a fleet request arrives with the ROUTER's
+        globally unique ``r<pid>-<seq>`` id in the wire header, and
+        adopting it is what joins this replica's spans to the router's
+        in the offline merge (ISSUE 16 — replica-local serving, with no
+        id to adopt, mints exactly as before)."""
+        return RequestTrace(
+            next(self._rid) if rid is None else rid,
+            deadline_s, self.clock(),
+        )
 
     def observe_completed(
         self,

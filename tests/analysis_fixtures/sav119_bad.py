@@ -1,0 +1,24 @@
+"""Known-bad fixture for SAV119: device syncs in the fleet router's
+TRACING surface — a blocking wait in the dispatch loop's stamp path, a
+device_get building the candidate-wait table, a float() pulling a
+device latency through __float__ in the span-ring fold, and an .item()
+in the heartbeat snapshot."""
+import jax
+
+
+class Router:
+    def _dispatch(self, job, metrics):
+        metrics["step"].block_until_ready()
+        self.stamps.append(("sent", self.clock()))
+
+    def _route_with_waits(self):
+        waits = jax.device_get(self.projections)
+        return 0, dict(enumerate(waits))
+
+    def _observe_completion(self, job, metrics):
+        latency = float(metrics["latency"])
+        self.ring.append({"latency_ms": latency * 1e3})
+
+    def router_beat(self, metrics):
+        depth = metrics["queue_depth"].item()
+        return self.writer.serve_beat({"queue": depth}, kind="router")

@@ -1,0 +1,31 @@
+"""Known-clean fixture for SAV119: the nearest legitimate idioms — the
+dispatch loop stamps monotonic clock reads, the wait table is host
+arithmetic over parsed heartbeat lines, the span-ring fold appends
+plain floats, and the heartbeat snapshot is counter reads (the router
+module is stdlib-only; no device value is in reach)."""
+import time
+
+
+class Router:
+    def _dispatch(self, job):
+        # Stamps are monotonic clock reads — the cheapest host op.
+        self.stamps.append(("route_selected", time.monotonic()))
+        self.stamps.append(("sent", time.monotonic()))
+
+    def _route_with_waits(self):
+        # Host comparison of host floats — nothing to sync.
+        waits = {r: self._projected_wait(r) for r in self.replicas}
+        return min(waits, key=waits.get), waits
+
+    def _observe_completion(self, job, latency_s):
+        self.ring.append({
+            "rid": job.rid,
+            "latency_ms": latency_s * 1e3,
+        })
+        self.window.observe(latency_s * 1e3)
+
+    def router_beat(self):
+        return self.writer.serve_beat(
+            {"completed": self.completed, "shed": self.shed},
+            kind="router",
+        )

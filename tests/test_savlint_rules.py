@@ -136,6 +136,12 @@ BAD_EXPECTATIONS = {
         ("SAV118", 19),  # float(metrics[...]) in note_result()
         ("SAV118", 23),  # metrics[...].item() in _refresh_views()
     ],
+    "sav119_bad.py": [
+        ("SAV119", 11),  # .block_until_ready() in _dispatch's stamp path
+        ("SAV119", 15),  # jax.device_get in _route_with_waits()
+        ("SAV119", 19),  # float(metrics[...]) in _observe_completion()
+        ("SAV119", 23),  # metrics[...].item() in router_beat()
+    ],
 }
 
 CLEAN_FIXTURES = [
@@ -157,6 +163,7 @@ CLEAN_FIXTURES = [
     "sav116_clean.py",
     "sav_tpu/parallel/sav117_clean.py",
     "sav118_clean.py",
+    "sav119_clean.py",
 ]
 
 

@@ -111,6 +111,13 @@ def test_router_hot_path_suppressions_are_zero():
     result = lint_paths([os.path.join(ROOT, "sav_tpu", "serve")], root=ROOT)
     assert [f for f in result.findings if f.rule == "SAV118"] == []
     assert [f for f in result.suppressed if f.rule == "SAV118"] == []
+    # SAV119 (router-trace-hot-path-sync, ISSUE 16): the tracing
+    # surface the router grew (_dispatch/_route_with_waits/
+    # _observe_completion/router_beat) carries ZERO suppressions too —
+    # observing a request must not slow it, with no sanctioned
+    # exceptions.
+    assert [f for f in result.findings if f.rule == "SAV119"] == []
+    assert [f for f in result.suppressed if f.rule == "SAV119"] == []
     for module in ("router.py", "fleet.py"):
         one = lint_paths(
             [os.path.join(ROOT, "sav_tpu", "serve", module)], root=ROOT

@@ -368,6 +368,222 @@ def test_router_rejects_past_max_inflight():
     router.close()
 
 
+# ------------------------------------ distributed tracing units (ISSUE 16)
+
+
+class StampingTransport:
+    """A stamp-aware fake transport (the TcpTransport seam): stamps
+    connect/sent at scripted clock instants, so the router's
+    transport_send / replica_wait intervals have pinned durations."""
+
+    supports_stamps = True
+
+    def __init__(self, clock, *, connect_s=0.002, exchange_s=0.010,
+                 result=None):
+        self.clock = clock
+        self.connect_s = connect_s
+        self.exchange_s = exchange_s
+        self.result = result if result is not None else {"ok": True}
+        self.metas = []
+
+    def send(self, rank, payload, meta, timeout_s, stamp_fn=None):
+        self.metas.append(dict(meta))
+        if stamp_fn is not None:
+            stamp_fn("connect")
+        self.clock.sleep(self.connect_s)
+        if stamp_fn is not None:
+            stamp_fn("sent")
+        self.clock.sleep(self.exchange_s)
+        r = self.result
+        if callable(r):
+            r = r()
+        if isinstance(r, BaseException):
+            raise r
+        return r
+
+
+def test_router_traces_full_walk_ring_and_export(tmp_path):
+    """Tentpole part 1: every admitted request is one end-to-end trace.
+    The router mints a globally unique ``r<pid>-<seq>`` id, propagates
+    it on the wire header (``meta["trace"]``), stamps the full
+    lifecycle through the transport's connect/sent seam, and exports
+    the span ring as a chrome trace at close — in the router interval
+    vocabulary, with rank/outcome join keys for the offline merge."""
+    from sav_tpu.obs.traceview import _span_bounds, load_trace
+
+    views = {0: _view()}
+    clock = FakeClock()
+    transport = StampingTransport(clock)
+    router, _, _ = make_router(
+        views, transport, clock=clock, log_dir=str(tmp_path)
+    )
+    first = router.admit(b"x", deadline_s=1.0)
+    second = router.admit(b"y", deadline_s=1.0)
+    assert first.result(timeout=0) == {"ok": True}
+    assert second.result(timeout=0) == {"ok": True}
+    rids = [m["trace"] for m in transport.metas]
+    assert rids == [f"r{os.getpid()}-0", f"r{os.getpid()}-1"]
+    assert len(set(rids)) == 2  # globally unique: pid + private seq
+    summ = router.summary()
+    assert summ["traces"] == {"ring": 2, "appended": 2}
+    assert summ["router_overhead_ms"] >= 0.0
+    router.close()
+    path = os.path.join(
+        str(tmp_path), "serve_traces", "requests_router.trace.json.gz"
+    )
+    assert os.path.exists(path)
+    bounds = _span_bounds(load_trace(path))
+    assert set(bounds) == set(rids)
+    at = bounds[rids[0]]["at"]
+    for name in ("admission", "router_queue", "route", "transport_send",
+                 "replica_wait", "deliver"):
+        assert name in at, f"missing {name} interval in the export"
+    # transport_send spans the socket's connect->sent instants (2 ms);
+    # the exchange itself is the opaque replica_wait (10 ms) the
+    # offline merge decomposes.
+    send = at["transport_send"]
+    assert send[1] - send[0] == pytest.approx(2000.0)
+    wait = at["replica_wait"]
+    assert wait[1] - wait[0] == pytest.approx(10000.0)
+    assert bounds[rids[0]]["args"]["rank"] == 0
+    assert bounds[rids[0]]["args"]["outcome"] == "completed"
+
+
+def test_reroute_records_attempt_sub_spans_and_candidate_waits():
+    """A rerouted request's trace carries one sub-span per attempt
+    (failed rank first, serving rank second) plus the candidate wait
+    table the routing decision saw — the Tail-at-Scale WHY."""
+    views = {0: _view(est_step_s=0.001), 1: _view(est_step_s=0.1)}
+    transport = FakeTransport({
+        0: ReplicaTransportError("connection reset"),
+        1: {"ok": True},
+    })
+    router, clock, _ = make_router(views, transport)
+    assert router.admit(b"x").result(timeout=0) == {"ok": True}
+    rec = router._ring.records()[0]
+    assert rec["outcome"] == "completed"
+    assert [a["rank"] for a in rec["attempts"]] == [0, 1]
+    assert [a["outcome"] for a in rec["attempts"]] == [
+        "transport_error", "ok",
+    ]
+    assert set(rec["candidate_waits_ms"]) == {0, 1}
+    assert rec["candidate_waits_ms"][0] < rec["candidate_waits_ms"][1]
+    assert rec["dominant_stage"] is not None
+    router.close()
+
+
+def test_shed_trace_ends_with_honest_terminal_stamp():
+    """A shed request's trace ends with the honest ``shed`` stamp —
+    never a fake ``completed`` — and folds into the ring with its real
+    outcome (the merged fleet view must show where load was refused)."""
+    views = {0: _view()}
+    transport = FakeTransport({0: ReplicaTransportError("dead")})
+    router, clock, _ = make_router(views, transport)
+    future = router.admit(b"x", deadline_s=0.25)
+    with pytest.raises(RouterShedError):
+        future.result(timeout=0)
+    rec = router._ring.records()[0]
+    assert rec["outcome"] == "shed"
+    assert rec["stamps"][-1][0] == "shed"
+    assert rec["hit"] is False
+    assert rec["rank"] is None
+    router.close()
+
+
+def test_live_and_summary_agree_mid_run():
+    """The ISSUE-16 bugfix pin: the throughput/percentiles serve_status
+    reads MID-RUN (``live()``) are the same numbers ``summary()``
+    reports at close — previously throughput existed only in the
+    close-time summary, so a mid-run status could not be compared to
+    the post-run record."""
+    views = {0: _view()}
+    clock = FakeClock()
+    transport = StampingTransport(clock, connect_s=0.0, exchange_s=0.01)
+    router, _, _ = make_router(views, transport, clock=clock)
+    for _ in range(5):
+        router.admit(b"x", deadline_s=5.0).result(timeout=0)
+        clock.sleep(0.09)  # spaced load; last gap is BEFORE the reads
+    clock.t = router._last_complete_t  # read at the last completion
+    live = router.live()
+    summ = router.summary()
+    assert live["completed"] == summ["completed"] == 5
+    assert live["throughput_rps"] == summ["throughput_rps"]
+    assert live["w"] == summ["window"]
+    # The windowed view divides by the EFFECTIVE span (run younger than
+    # the window), so the windowed rate agrees with the span rate too.
+    assert live["w"]["throughput_rps"] == summ["throughput_rps"]
+    assert live["w"]["p99_ms"] == summ["latency_ms"]["p99"] == 10.0
+    # Stage shares: the whole windowed latency sat in replica_wait.
+    assert live["w"]["stage_shares"] == {"replica_wait": 1.0}
+    assert live["router_overhead_ms"] == summ["router_overhead_ms"]
+    router.close()
+
+
+def test_router_heartbeats_on_fleet_substrate(tmp_path):
+    """The router is a first-class fleet citizen: ``kind=router`` beats
+    on the PR-7 heartbeat substrate (``fleet/router.jsonl``), carrying
+    the live windowed view + the trace-overhead meter; close() appends
+    a final beat so the last written state is the closing state."""
+    from sav_tpu.obs.fleet import read_router_beats
+
+    views = {0: _view()}
+    clock = FakeClock()
+    transport = StampingTransport(clock, connect_s=0.0, exchange_s=0.01)
+    router, _, _ = make_router(
+        views, transport, clock=clock, log_dir=str(tmp_path)
+    )
+    router.admit(b"x", deadline_s=5.0).result(timeout=0)
+    assert router.router_beat() is True
+    beats = read_router_beats(str(tmp_path))
+    assert len(beats) == 1
+    beat = beats[0]
+    assert beat["kind"] == "router"
+    assert beat["completed"] == 1
+    assert beat["w"]["requests"] == 1
+    assert beat["w"]["p99_ms"] == 10.0
+    assert "router_overhead_ms" in beat
+    assert os.path.exists(
+        os.path.join(str(tmp_path), "fleet", "router.jsonl")
+    )
+    router.close()
+    final = read_router_beats(str(tmp_path))
+    assert len(final) == 2  # close() appended the closing beat
+    assert final[-1]["completed"] == 1
+
+
+def test_plain_transport_degrades_to_contiguous_stamps():
+    """A transport WITHOUT the stamp seam still produces a contiguous
+    walk: connect/sent collapse to the pre-send instant, so the whole
+    exchange lands in replica_wait and no interval is missing."""
+    from sav_tpu.serve.telemetry import ROUTER_INTERVALS, intervals
+
+    views = {0: _view()}
+    router, clock, _ = make_router(
+        views, FakeTransport({0: {"ok": True}})
+    )
+    router.admit(b"x").result(timeout=0)
+    rec = router._ring.records()[0]
+    stages = intervals(rec["stamps"], ROUTER_INTERVALS)
+    assert set(stages) >= {"transport_send", "replica_wait", "deliver"}
+    assert stages["transport_send"] == 0.0
+    router.close()
+
+
+def test_tcp_transport_declares_the_stamp_seam():
+    """The production TcpTransport is the stamp-aware side of the seam:
+    the capability flag the router keys on, and the send/_exchange
+    signatures that accept the stamp callback."""
+    import inspect
+
+    from sav_tpu.serve.fleet import TcpTransport
+
+    assert TcpTransport.supports_stamps is True
+    assert "stamp_fn" in inspect.signature(TcpTransport.send).parameters
+    assert "stamp_fn" in inspect.signature(
+        TcpTransport._exchange
+    ).parameters
+
+
 # --------------------------------- heartbeat artifacts -> suspicion/views
 
 
@@ -512,6 +728,63 @@ def test_sentinel_scores_fleet_fixtures_both_directions(capsys):
     out = capsys.readouterr().out
     assert "fleet_p99_latency_ms" in out
     assert "fleet_throughput" in out
+
+
+def test_sentinel_scores_router_overhead_both_directions(capsys):
+    """router_overhead_ms (ISSUE 16): the router's self-accounted
+    tracing cost is sentinel-gated — flat history stays ok, a jump past
+    the 0.05 ms absolute floor flags (observability taxing the routing
+    hot path IS a regression), while the surrounding fleet metrics stay
+    clean in both fixture directions."""
+    assert _sentinel([os.path.join(FIXDIR, "router_clean")]) == 0
+    out = capsys.readouterr().out
+    assert "router_overhead_ms" in out
+    assert _sentinel([os.path.join(FIXDIR, "router_regressed")]) == 1
+    out = capsys.readouterr().out
+    assert "REGRESS router_overhead_ms" in out
+    assert "REGRESS fleet" not in out  # only the overhead series moved
+
+
+def test_router_overhead_skip_not_zero_fill():
+    """Records lacking router_overhead_ms (pre-16 fleet records, plain
+    serve records, training records) are SKIPPED, never zero-filled —
+    the attention_core_frac presence contract — and the metric reads
+    from both record shapes (bench line + serve_fleet manifest)."""
+    sys.path.insert(0, os.path.join(ROOT, "tools"))
+    try:
+        from regression_sentinel import judge_metric
+    finally:
+        sys.path.pop(0)
+    from sav_tpu.obs.manifest import MANIFEST_SCHEMA, normalize_run_record
+
+    traced = {
+        "outcome": "ok", "fleet_p99_latency_ms": 35.0,
+        "fleet_throughput": 700.0, "router_overhead_ms": 0.02,
+    }
+    rec = normalize_run_record(traced, label="traced", index=0)
+    assert rec.metrics["router_overhead_ms"] == 0.02
+    manifest = {
+        "schema": MANIFEST_SCHEMA, "outcome": "ok", "kind": "serve_fleet",
+        "metrics": {"fleet/router_overhead_ms": 0.03},
+    }
+    mrec = normalize_run_record(manifest, label="m", index=1)
+    assert mrec.metrics["router_overhead_ms"] == 0.03
+    # A pre-16 fleet record lacks it entirely — never zero-filled.
+    untraced = normalize_run_record(
+        {"outcome": "ok", "fleet_p99_latency_ms": 35.0,
+         "fleet_throughput": 700.0},
+        label="old", index=2,
+    )
+    assert "router_overhead_ms" not in untraced.metrics
+    # Newest record lacking it -> unscorable, not re-judged stale.
+    records = [
+        normalize_run_record(dict(traced), label=f"t{i}", index=i)
+        for i in range(3)
+    ] + [untraced]
+    assert judge_metric(
+        records, "router_overhead_ms", k=3.5, rel_floor=0.05,
+        min_history=2,
+    ) is None
 
 
 def test_fleet_metrics_skip_not_zero_fill():
@@ -851,6 +1124,94 @@ def test_fleet_smoke_two_replicas_router_shifts_load(
     assert mdoc["metrics"]["fleet/p99_latency_ms"] == (
         line["fleet_p99_latency_ms"]
     )
+    # ---------------- distributed tracing acceptance (ISSUE 16) ----------
+    # ONE merged chrome trace for the whole fleet run: the router's span
+    # ring + both replicas' exports joined offline into contiguous
+    # router->replica->router chains.
+    from sav_tpu.obs.traceview import fleet_request_spans, load_trace
+
+    traces = line["serve_traces"]
+    assert traces["router"] and os.path.exists(traces["router"])
+    assert len(traces["replicas"]) == 2
+    assert traces["merged"] and traces["merged"].endswith(
+        "fleet.trace.json.gz"
+    )
+    # The per-request stamp cost stays bounded (<= 100 us/request, the
+    # acceptance contract), measured by the router's own meter.
+    assert line["router_overhead_ms"] is not None
+    assert line["router_overhead_ms"] <= 0.1, (
+        f"router tracing overhead {line['router_overhead_ms']}ms/request "
+        "blew the 100us contract"
+    )
+    merged = fleet_request_spans(log_dir)
+    assert merged["requests"], "the merge joined no requests"
+    full = {
+        rid: e for rid, e in merged["requests"].items()
+        if not e["router_only"]
+    }
+    assert full, "no request merged across processes (all router-only)"
+    for rid, e in merged["requests"].items():
+        stages = e["stages"]
+        assert stages, f"{rid} merged with an empty chain"
+        # Contiguous: each stage starts where the previous ended.
+        for prev, cur in zip(stages, stages[1:]):
+            assert cur[1] == pytest.approx(
+                prev[1] + prev[2], abs=2e-3
+            ), f"{rid} chain is not contiguous at {cur[0]}"
+    # Per-request stage sums match the client-observed latency within
+    # the stamped skew bound (plus the sub-ms pre-admit sliver and
+    # rounding).
+    for rid, e in full.items():
+        client_ms = e["deadline_ms"] + e["overrun_ms"]
+        skew = e["skew_ms"] or 0.0
+        assert abs(client_ms - e["total_ms"]) <= skew + 10.0, (
+            f"{rid}: merged chain {e['total_ms']}ms vs client "
+            f"{client_ms}ms exceeds the {skew}ms skew bound"
+        )
+    # Every replica the merge used states its clock skew honestly.
+    assert merged["replicas"], "no per-replica clock offset estimated"
+    for proc, est in merged["replicas"].items():
+        assert est["pairs"] >= 1
+        assert est["skew_ms"] >= 0.0
+    # The induced straggler (rank 1, +0.35 s per batch) shows up in the
+    # fleet exemplars with the blame on the REPLICA side of the chain —
+    # the cross-process attribution this PR exists for.
+    exemplar_paths = sorted(
+        p for p in os.listdir(os.path.join(log_dir, "serve_traces"))
+        if p.startswith("slow_fleet_")
+    )
+    assert exemplar_paths, "no fleet exemplars written"
+    exemplars = []
+    for name in exemplar_paths:
+        with open(os.path.join(log_dir, "serve_traces", name)) as f:
+            exemplars.append(json.load(f))
+    assert line["serve_traces"]["fleet_exemplars"] == len(exemplars)
+    straggled = [
+        e for e in exemplars
+        if not e["router_only"]
+        and e["dominant_stage"] in ("replica_queue", "device")
+    ]
+    assert straggled, (
+        "no exemplar blamed the straggler's replica-side stages: "
+        f"{[(e['rid'], e['dominant_stage']) for e in exemplars]}"
+    )
+    # The merged artifact is ONE trace every existing consumer reads.
+    events = load_trace(traces["merged"])
+    fleet_names = {
+        e["args"]["name"] for e in events if e.get("ph") == "M"
+    }
+    assert fleet_names == {"Fleet Requests"}
+    # The router heartbeated as a fleet citizen (kind=router stream),
+    # and serve_status surfaced both the beats and the live window.
+    from sav_tpu.obs.fleet import read_router_beats
+
+    beats = read_router_beats(log_dir)
+    assert beats, "router wrote no kind=router heartbeats"
+    assert beats[-1]["completed"] == acct["completed"]
+    assert summary["router_beats"] >= 1
+    assert summary["router_live"]["completed"] == acct["completed"]
+    # The manifest points at every trace artifact (run_report's hook).
+    assert mdoc["notes"]["serve_traces"]["merged"] == traces["merged"]
 
 
 def test_fleet_chaos_sigkill_mid_flood_bounded_p99_warm_restart(

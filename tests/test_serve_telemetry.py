@@ -910,3 +910,22 @@ def test_serve_bench_zero_requests_honest_line(tmp_path):
         data = json.load(f)
     assert data["outcome"] == "ok"
     assert "serve/slo_hit_frac" not in data["metrics"]
+
+
+def test_begin_trace_adopts_propagated_fleet_id():
+    """ISSUE 16 propagation: begin_trace ADOPTS a router-minted trace
+    id (the wire header's ``r<pid>-<seq>``) instead of minting a
+    replica-local one — the adoption is what joins the replica's spans
+    to the router's in the offline fleet merge. Replica-local serving
+    (no id to adopt) mints from the local counter exactly as before,
+    and adoption does not consume local ids."""
+    clock = FakeClock()
+    telemetry = ServeTelemetry(clock=clock)
+    local = telemetry.begin_trace(0.5)
+    assert local.rid == 1
+    adopted = telemetry.begin_trace(0.25, rid="r4242-7")
+    assert adopted.rid == "r4242-7"
+    assert adopted.deadline_s == 0.25
+    assert adopted.stamps[0][0] == "submit"
+    # The local counter did not advance for the adopted id.
+    assert telemetry.begin_trace(0.5).rid == 2

@@ -1,0 +1,146 @@
+#!/usr/bin/env python
+"""Regenerate the fleet-merge golden fixture (ISSUE 16, checked in).
+
+A hand-pinned two-replica fleet log under ``fleet_golden/serve_traces/``
+exercising every path of ``traceview.fleet_request_spans``:
+
+  requests_router.trace.json.gz — the router's span-ring export: four
+    requests in the ``ROUTER_INTERVALS`` vocabulary, args carrying
+    rank/outcome (the join keys) plus deadline/overrun.
+  requests_proc0.trace.json.gz — replica 0's export with a DELIBERATE
+    +5 s clock skew (its stamps read 5 s ahead of the router's): two
+    complete replica walks for requests A and B. The merge must recover
+    the offset from the handshake pairs — hand-worked below — and emit
+    contiguous router→replica→router chains.
+  requests_proc1.trace.json.gz — replica 1's export is TORN: request C's
+    record lacks the device span, so C must degrade to the router-only
+    chain (never dropped). Request D (shed before the exchange) has no
+    replica record at all and keeps its raw router spans.
+
+Hand-worked offset (replica 0; replica clock + offset = router clock,
+true offset −5 s): request A bounds the offset to [−5.0010, −4.9970] s,
+request B to [−5.0005, −4.9970] s; the intersection's midpoint is
+−4.99875 s (−4998.75 ms) with half-width 1.75 ms — the skew bound the
+merge stamps into its output. The merged chains those numbers produce
+are pinned in ``tests/test_traceview.py`` — change either side
+consciously.
+
+Deterministic output (gzip mtime pinned to 0):
+``python tests/trace_fixtures/make_fleet_golden.py``.
+"""
+
+import gzip
+import json
+import os
+
+from sav_tpu.serve.telemetry import (
+    INTERVALS,
+    ROUTER_INTERVALS,
+    export_chrome_trace,
+)
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+OUT = os.path.join(HERE, "fleet_golden", "serve_traces")
+
+# Replica 0's clock reads 5 s AHEAD of the router's.
+SKEW_0 = 5.0
+
+
+def _rec(rid, stamps, *, rank, outcome, deadline_ms, overrun_ms):
+    return {
+        "rid": rid,
+        "stamps": stamps,
+        "rank": rank,
+        "outcome": outcome,
+        "deadline_ms": deadline_ms,
+        "overrun_ms": overrun_ms,
+    }
+
+
+def router_records():
+    return [
+        _rec("rA", [
+            ("submit", 10.0000), ("admit", 10.0002),
+            ("route_selected", 10.0010), ("connect", 10.0015),
+            ("sent", 10.0020), ("reply", 10.0220),
+            ("completed", 10.0225),
+        ], rank=0, outcome="completed", deadline_ms=100.0,
+            overrun_ms=-77.5),
+        _rec("rB", [
+            ("submit", 11.0000), ("admit", 11.0002),
+            ("route_selected", 11.0008), ("connect", 11.0012),
+            ("sent", 11.0015), ("reply", 11.0215),
+            ("completed", 11.0220),
+        ], rank=0, outcome="completed", deadline_ms=100.0,
+            overrun_ms=-78.0),
+        _rec("rC", [
+            ("submit", 12.0000), ("admit", 12.0003),
+            ("route_selected", 12.0010), ("connect", 12.0014),
+            ("sent", 12.0018), ("reply", 12.0318),
+            ("completed", 12.0322),
+        ], rank=1, outcome="completed", deadline_ms=30.0,
+            overrun_ms=2.2),
+        # Shed on the dispatch path before any exchange: admission is
+        # the only closed interval; the honest terminal stamp ends no
+        # interval. The merge must keep this request (router-only).
+        _rec("rD", [
+            ("submit", 13.0000), ("admit", 13.0002), ("shed", 13.5000),
+        ], rank=None, outcome="shed", deadline_ms=400.0,
+            overrun_ms=100.0),
+    ]
+
+
+def replica0_records():
+    def shift(stamps):
+        return [(name, t + SKEW_0) for name, t in stamps]
+
+    return [
+        {"rid": "rA", "stamps": shift([
+            ("submit", 10.0030), ("admit", 10.0032),
+            ("batch_formed", 10.0060), ("placed", 10.0062),
+            ("dispatched", 10.0070), ("executed", 10.0170),
+            ("depadded", 10.0180), ("completed", 10.0190),
+        ])},
+        {"rid": "rB", "stamps": shift([
+            ("submit", 11.0020), ("admit", 11.0022),
+            ("batch_formed", 11.0040), ("placed", 11.0042),
+            ("dispatched", 11.0050), ("executed", 11.0150),
+            ("depadded", 11.0180), ("completed", 11.0185),
+        ])},
+    ]
+
+
+def replica1_records():
+    # Torn: request C's record ends at admission — no device span, so
+    # _replica_boundaries returns None and C degrades to router-only.
+    return [
+        {"rid": "rC", "stamps": [
+            ("submit", 12.5000), ("admit", 12.5002),
+        ]},
+    ]
+
+
+def write(name, doc):
+    os.makedirs(OUT, exist_ok=True)
+    path = os.path.join(OUT, name)
+    with open(path, "wb") as raw:
+        with gzip.GzipFile(fileobj=raw, mode="wb", mtime=0) as f:
+            f.write(json.dumps(doc, sort_keys=True).encode())
+    print(f"wrote {path}")
+
+
+def main():
+    write("requests_router.trace.json.gz", export_chrome_trace(
+        router_records(), ROUTER_INTERVALS,
+        process_name="Fleet Router", extra_args=("rank", "outcome"),
+    ))
+    write("requests_proc0.trace.json.gz", export_chrome_trace(
+        replica0_records(), INTERVALS, process_name="Replica 0",
+    ))
+    write("requests_proc1.trace.json.gz", export_chrome_trace(
+        replica1_records(), INTERVALS, process_name="Replica 1",
+    ))
+
+
+if __name__ == "__main__":
+    main()

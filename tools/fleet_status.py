@@ -43,6 +43,7 @@ from sav_tpu.obs.fleet import (  # noqa: E402
     format_unix as _fmt_unix,
     read_autoprof_captures as autoprof_captures,
     read_probe_timeline,
+    read_router_beats,
 )
 from sav_tpu.serve.telemetry import aggregate_serve  # noqa: E402
 
@@ -179,6 +180,23 @@ def render(log_dir: str, summary: dict, out) -> None:
                 + f", shed {v.get('shed')}{flame}",
                 file=out,
             )
+    # kind=router heartbeat stream (ISSUE 16): the fleet router is a
+    # first-class fleet citizen — its live windowed view renders next
+    # to the replicas it balances (full detail: tools/serve_status.py).
+    router_beats = read_router_beats(log_dir, tail_bytes=262_144)
+    if router_beats:
+        live = router_beats[-1]
+        w = live.get("w") or {}
+        print(
+            f"Router: {len(router_beats)} heartbeat(s) — "
+            f"{live.get('completed')} completed, p99 {w.get('p99_ms')} ms "
+            f"@ {live.get('throughput_rps')} req/s, "
+            f"{live.get('rerouted')} rerouted, {live.get('shed')} shed, "
+            f"{live.get('down_flaps')} down-flaps, view age "
+            f"{live.get('view_age_s')}s, trace overhead "
+            f"{live.get('router_overhead_ms')} ms/req",
+            file=out,
+        )
     layouts = read_layout_notes(log_dir)
     if layouts:
         print(f"Layouts: {len(layouts)} manifest(s)", file=out)

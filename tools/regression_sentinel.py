@@ -126,6 +126,16 @@ METRICS = {
     # throughput means the ROUTER became the bottleneck (bad balancing,
     # over-shedding). Same presence contract as fleet_p99_latency_ms.
     "fleet_throughput": (True, 0.0),
+    # Router tracing overhead per completed request (ms — the router's
+    # self-accounted trace/stamp/window cost, ISSUE 16; the fleet twin
+    # of the engine's serve_overhead accounting). Lower is better — a
+    # rise means the observability layer itself started taxing the
+    # routing hot path. Present only on traced fleet records; older
+    # fleet records and everything else are skipped, not zero-filled.
+    # Absolute floor 0.05 ms: the contract bounds the stamp cost near
+    # 0.1 ms/request, so sub-50µs jitter on a flat history is
+    # scheduler noise, not a regression.
+    "router_overhead_ms": (False, 0.05),
 }
 
 EXIT_CLEAN, EXIT_REGRESSION, EXIT_USAGE = 0, 1, 2

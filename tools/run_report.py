@@ -53,9 +53,11 @@ if _REPO_ROOT not in sys.path:  # runnable as a script from anywhere
 from sav_tpu.obs.fleet import (  # noqa: E402
     aggregate_fleet,
     fleet_dir,
+    iter_manifests,
     read_probe_timeline,
 )
 from sav_tpu.obs.manifest import load_run_history  # noqa: E402
+from sav_tpu.obs.traceview import fleet_request_spans  # noqa: E402
 from sav_tpu.serve.telemetry import (  # noqa: E402
     aggregate_serve,
     find_exemplars,
@@ -598,7 +600,23 @@ def report_serve(log_dir: str, out, manifests: list = None) -> None:
     serve = aggregate_serve(log_dir)
     replicas = serve.get("replicas") or {}
     exemplars = find_exemplars(log_dir)
-    if not manifests and not replicas:
+    # notes.serve_traces lives on the kind=serve_fleet manifest (the
+    # fleet bench's), which find_serve_manifests (kind=serve only)
+    # deliberately excludes — scan the full manifest set for it. Found
+    # traces keep a fleet-only dir (no per-replica serve manifests)
+    # from reading as "no serve telemetry".
+    trace_notes = [
+        note
+        for _, doc in iter_manifests(log_dir)
+        if isinstance(
+            (note := (doc.get("notes") or {}).get("serve_traces")), dict
+        )
+    ]
+    router_export = os.path.join(
+        log_dir, "serve_traces", "requests_router.trace.json.gz"
+    )
+    has_fleet_traces = bool(trace_notes) or os.path.isfile(router_export)
+    if not manifests and not replicas and not has_fleet_traces:
         print(f"(no serve telemetry under {log_dir})", file=out)
         return
     for m in manifests:
@@ -647,10 +665,71 @@ def report_serve(log_dir: str, out, manifests: list = None) -> None:
             file=out,
         )
         for e in exemplars[:5]:
+            where = " [fleet walk]" if e.get("fleet") else ""
             print(
                 f"    req {e.get('rid')}: {e.get('latency_ms')} ms "
                 f"(overrun {e.get('overrun_ms')} ms) — "
-                f"{e.get('dominant_stage')} dominated",
+                f"{e.get('dominant_stage')} dominated{where}",
+                file=out,
+            )
+    # Fleet trace section (ISSUE 16): render the notes.serve_traces
+    # pointers the fleet bench stamped, plus the merged-trace headline
+    # (clock offsets + dominant fleet stages) when the merge is on
+    # disk or derivable.
+    merged_path = os.path.join(
+        log_dir, "serve_traces", "fleet.trace.json.gz"
+    )
+    if has_fleet_traces:
+        for note in trace_notes:
+            n_rep = len(note.get("replicas") or [])
+            print(
+                "  distributed traces: router export "
+                + ("yes" if note.get("router") else "MISSING")
+                + f", {n_rep} replica export(s), merged "
+                + (
+                    os.path.basename(note["merged"])
+                    if note.get("merged") else "MISSING"
+                )
+                + f", {note.get('fleet_exemplars', 0)} fleet exemplar(s)",
+                file=out,
+            )
+        try:
+            fleet = fleet_request_spans(log_dir)
+        except (OSError, ValueError, KeyError, TypeError):
+            fleet = {"requests": {}, "replicas": {}}
+        if fleet.get("requests"):
+            dom: dict = {}
+            router_only = 0
+            for entry in fleet["requests"].values():
+                ds = entry.get("dominant_stage")
+                if ds:
+                    dom[ds] = dom.get(ds, 0) + 1
+                if entry.get("router_only"):
+                    router_only += 1
+            dom_s = ", ".join(
+                f"{k} x{v}"
+                for k, v in sorted(dom.items(), key=lambda kv: -kv[1])
+            )
+            skews = [
+                est.get("skew_ms") for est in fleet["replicas"].values()
+                if isinstance(est.get("skew_ms"), (int, float))
+            ]
+            print(
+                f"  merged fleet trace: {len(fleet['requests'])} "
+                f"request walk(s)"
+                + (
+                    f", clock skew bound +/-{max(skews)} ms"
+                    if skews else ""
+                )
+                + (
+                    f", {router_only} router-only (degraded)"
+                    if router_only else ""
+                )
+                + (f" — dominant stages: {dom_s}" if dom_s else "")
+                + (
+                    f" (see tools/trace_report.py {merged_path})"
+                    if os.path.isfile(merged_path) else ""
+                ),
                 file=out,
             )
 

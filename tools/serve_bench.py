@@ -49,6 +49,7 @@ Usage:
 from __future__ import annotations
 
 import argparse
+import glob
 import json
 import os
 import sys
@@ -208,6 +209,7 @@ def run_fleet(args, manifest) -> dict:
             ranks=range(args.replicas),
             workers=args.fleet_workers,
             log_dir=log_dir,
+            heartbeat_secs=args.heartbeat_secs,
         )
         rng = np.random.default_rng(0)
         payloads = [
@@ -331,6 +333,29 @@ def run_fleet(args, manifest) -> dict:
             router.close()
         pool.stop()
     status = pool.status()
+    # Distributed tracing (ISSUE 16): with the router's span ring and
+    # the replicas' exports both on disk, run the offline clock-aligned
+    # merge NOW so the line/manifest carry pointers to every artifact
+    # (router export + per-replica exports + ONE merged fleet trace)
+    # and the slowest cross-process walks land as fleet exemplars.
+    from sav_tpu.obs.traceview import write_fleet_exemplars, write_fleet_trace
+
+    traces_dir = os.path.join(log_dir, "serve_traces")
+    router_export = os.path.join(
+        traces_dir, "requests_router.trace.json.gz"
+    )
+    serve_traces = {
+        "router": (
+            router_export if os.path.isfile(router_export) else None
+        ),
+        "replicas": sorted(
+            glob.glob(
+                os.path.join(traces_dir, "requests_proc*.trace.json.gz")
+            )
+        ),
+        "merged": write_fleet_trace(log_dir),
+        "fleet_exemplars": len(write_fleet_exemplars(log_dir)),
+    }
     endpoints = read_endpoints(log_dir)
     startup_warm = {
         str(rank): ((doc.get("startup") or {}).get("compiled_from_scratch"))
@@ -385,9 +410,11 @@ def run_fleet(args, manifest) -> dict:
         "accounting": accounting,
         "rerouted": summary["rerouted"],
         "transport_failures": summary["transport_failures"],
+        "router_overhead_ms": summary.get("router_overhead_ms"),
         "restarts": status["restarts"],
         "startup_warm": startup_warm,
         "router": summary,
+        "serve_traces": serve_traces,
         "manifest": manifest.path,
         "log_dir": log_dir,
     }
@@ -408,6 +435,10 @@ def run_fleet(args, manifest) -> dict:
         metrics["fleet/p99_latency_ms"] = float(latency["p99"])
     if isinstance(summary.get("throughput_rps"), (int, float)):
         metrics["fleet/throughput_rps"] = float(summary["throughput_rps"])
+    if isinstance(summary.get("router_overhead_ms"), (int, float)):
+        metrics["fleet/router_overhead_ms"] = float(
+            summary["router_overhead_ms"]
+        )
     manifest.note("metric", out["metric"])
     if platform:
         manifest.note("platform", platform)
@@ -417,6 +448,7 @@ def run_fleet(args, manifest) -> dict:
         "chaos": chaos,
         "probe_routed": probe_routed,
     })
+    manifest.note("serve_traces", serve_traces)
     manifest.finalize(
         outcome,
         error=(

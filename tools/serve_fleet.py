@@ -233,8 +233,14 @@ def run_replica(args) -> int:
                 return  # torn request: the client is gone
             image = np.frombuffer(payload, np.uint8).reshape(s, s, 3)
             deadline_ms = header.get("deadline_ms")
+            # Distributed tracing (ISSUE 16): the router's trace id
+            # rides the header; the engine's begin_trace ADOPTS it so
+            # this replica's spans join the fleet-wide trace by id.
+            trace_id = header.get("trace")
             try:
-                future = engine.submit(image, deadline_ms=deadline_ms)
+                future = engine.submit(
+                    image, deadline_ms=deadline_ms, trace_id=trace_id
+                )
                 deadline_s = (
                     float(deadline_ms) / 1e3 if deadline_ms is not None
                     else config.deadline_ms / 1e3

@@ -44,6 +44,7 @@ if _REPO_ROOT not in sys.path:  # runnable as a script from anywhere
 from sav_tpu.obs.fleet import (  # noqa: E402
     format_unix as _fmt_unix,
     read_autoprof_captures as autoprof_captures,
+    read_router_beats,
 )
 from sav_tpu.serve.router import read_router_summary  # noqa: E402
 from sav_tpu.serve.telemetry import (  # noqa: E402
@@ -69,6 +70,12 @@ def gather(log_dir: str) -> dict:
     # router ran over this log dir (serve_bench --replicas / the
     # serve_fleet pool).
     summary["router"] = read_router_summary(log_dir)
+    # Live router view (ISSUE 16): the kind=router heartbeat stream —
+    # a STILL-RUNNING router is observable mid-run from here, with the
+    # same windowed numbers its close-time summary will report.
+    beats = read_router_beats(log_dir, tail_bytes=262_144)
+    summary["router_beats"] = len(beats)
+    summary["router_live"] = beats[-1] if beats else None
     return summary
 
 
@@ -145,6 +152,16 @@ def render(log_dir: str, summary: dict, out) -> None:
             f"{router.get('throughput_rps')} req/s",
             file=out,
         )
+        roh = router.get("router_overhead_ms")
+        window = router.get("window") or {}
+        if roh is not None or window:
+            print(
+                f"  trace overhead {roh} ms/req, window p99 "
+                f"{window.get('p99_ms')} ms @ "
+                f"{window.get('throughput_rps')} req/s, stage shares "
+                f"{json.dumps(window.get('stage_shares') or {})}",
+                file=out,
+            )
         for rank, v in sorted(
             (router.get("replicas") or {}).items(),
             key=lambda kv: int(kv[0]),
@@ -156,6 +173,31 @@ def render(log_dir: str, summary: dict, out) -> None:
                 + (
                     f" ({v.get('down_reason')})"
                     if v.get("down_reason") else ""
+                ),
+                file=out,
+            )
+    live = summary.get("router_live")
+    if live:
+        w = live.get("w") or {}
+        print(
+            f"Router heartbeats: {summary.get('router_beats')} on "
+            "fleet/router.jsonl — live window: "
+            f"{live.get('completed')} completed, p99 "
+            f"{w.get('p99_ms')} ms, {live.get('throughput_rps')} req/s, "
+            f"{live.get('rerouted')} rerouted, {live.get('shed')} shed, "
+            f"{live.get('down_flaps')} down-flaps, view age "
+            f"{live.get('view_age_s')}s, overhead "
+            f"{live.get('router_overhead_ms')} ms/req",
+            file=out,
+        )
+        shares = w.get("stage_shares") or {}
+        if shares:
+            print(
+                "  stage shares: "
+                + ", ".join(
+                    f"{k} {v:.0%}" for k, v in sorted(
+                        shares.items(), key=lambda kv: -kv[1]
+                    )
                 ),
                 file=out,
             )

@@ -191,6 +191,58 @@ def render(summary: dict, out) -> None:
             )
         if len(ranked) > 10:
             print(f"  ... and {len(ranked) - 10} more", file=out)
+    fleet = summary.get("fleet") or {}
+    if fleet.get("requests"):
+        # Merged fleet walks (obs/traceview.fleet_request_spans,
+        # ISSUE 16): one contiguous router->replica->router chain per
+        # request, replica clocks aligned within the stamped skew.
+        print(
+            f"fleet request walks: {len(fleet['requests'])} request(s) "
+            "merged across clock domains",
+            file=out,
+        )
+        for proc, est in sorted(fleet.get("replicas", {}).items()):
+            print(
+                f"  replica {proc} clock offset {est.get('offset_ms')} ms "
+                f"(skew bound +/-{est.get('skew_ms')} ms over "
+                f"{est.get('pairs')} handshakes)",
+                file=out,
+            )
+        dom = fleet.get("dominant_stages") or {}
+        if dom:
+            total = sum(dom.values()) or 1
+            print("  dominant stages (fleet vocabulary):", file=out)
+            for name, n in dom.items():
+                print(
+                    f"    {name:<16} {n:>5d}  {n / total:>6.1%}  "
+                    f"{_bar(n / total)}",
+                    file=out,
+                )
+        ranked = sorted(
+            fleet["requests"].items(),
+            key=lambda kv: -(kv[1].get("total_ms") or 0.0),
+        )
+        for rid, view in ranked[:10]:
+            walk = " -> ".join(
+                f"{name} {dur:.1f}ms" for name, _, dur in view["stages"]
+            )
+            tags = []
+            if view.get("router_only"):
+                tags.append("ROUTER-ONLY (replica export missing)")
+            overrun = view.get("overrun_ms")
+            if isinstance(overrun, (int, float)) and overrun > 0:
+                tags.append(
+                    f"OVERRAN by {overrun} ms — "
+                    f"{view.get('dominant_stage')} dominated"
+                )
+            print(
+                f"  req {rid} [rank {view.get('rank')}, "
+                f"{view.get('outcome')}]: {view.get('total_ms')} ms "
+                f"({walk})" + ("  " + "; ".join(tags) if tags else ""),
+                file=out,
+            )
+        if len(ranked) > 10:
+            print(f"  ... and {len(ranked) - 10} more", file=out)
 
 
 def main(argv=None) -> int:
@@ -312,6 +364,47 @@ def main(argv=None) -> int:
             spans = {}
     if spans:
         summary["request_spans"] = {str(k): v for k, v in spans.items()}
+    # Merged fleet trace (ISSUE 16): when the log dir carries a router
+    # span-ring export, run the offline clock-aligned join and render
+    # the cross-process walks + the dominant-stage table (the battery's
+    # --json consumer reads summary["fleet"]["dominant_stages"]).
+    log_dir = args.path if os.path.isdir(args.path) else None
+    if log_dir is None:
+        probe = os.path.dirname(os.path.abspath(trace))
+        for _ in range(4):
+            if os.path.isfile(os.path.join(
+                probe, "serve_traces", "requests_router.trace.json.gz"
+            )):
+                log_dir = probe
+                break
+            parent = os.path.dirname(probe)
+            if parent == probe:
+                break
+            probe = parent
+    if log_dir is not None:
+        try:
+            fleet = traceview.fleet_request_spans(log_dir)
+        except (OSError, ValueError, KeyError, TypeError):
+            fleet = None
+        if fleet and fleet.get("requests"):
+            dominant: dict = {}
+            for entry in fleet["requests"].values():
+                ds = entry.get("dominant_stage")
+                if ds:
+                    dominant[ds] = dominant.get(ds, 0) + 1
+            summary["fleet"] = {
+                "schema": fleet["schema"],
+                "router_export": fleet.get("router_export"),
+                "replicas": {
+                    str(k): v for k, v in fleet["replicas"].items()
+                },
+                "requests": {
+                    str(k): v for k, v in fleet["requests"].items()
+                },
+                "dominant_stages": dict(
+                    sorted(dominant.items(), key=lambda kv: -kv[1])
+                ),
+            }
     if args.json:
         print(json.dumps(summary, indent=2))
     else:

@@ -1390,6 +1390,89 @@ class AdhocPartitionSpec(Rule):
                 )
 
 
+# ---------------------------------------------------------------- SAV120
+
+
+class UnscaledInt8Cast(Rule):
+    """Raw int8 cast outside the quantization module.
+
+    ``sav_tpu/ops/quant.py`` is the single source of int8 truth (ISSUE
+    17): every int8 tensor in the repo is born next to a per-channel
+    scale (``quantize_channelwise`` / ``quantize_stochastic``) so that
+    ``q * scale ≈ a`` always holds and the int32-accumulating dot can
+    dequantize on exit. A bare ``x.astype(jnp.int8)`` or
+    ``jnp.asarray(x, jnp.int8)`` anywhere else in the model/op/serve
+    stack produces an int8 tensor with NO scale: values outside
+    [-128, 127] wrap silently, fractional values truncate, and the
+    result still *type-checks* into every quantized dot — the numeric
+    corruption only surfaces as an accuracy drift long after the cast.
+    Scoped to ``sav_tpu/ops|models|serve`` (the layers quantized
+    tensors flow through); ``quant.py`` itself is exempt — scaled casts
+    are its whole job.
+    """
+
+    id = "SAV120"
+    name = "unscaled-int8-cast"
+    severity = "error"
+    hint = (
+        "go through sav_tpu.ops.quant (quantize_channelwise / "
+        "quantize_stochastic / quantize_params) so the int8 tensor "
+        "carries its per-channel scale; if an unscaled cast is truly "
+        "intentional, pragma it with a justification"
+    )
+
+    SCOPE = ("sav_tpu/ops/", "sav_tpu/models/", "sav_tpu/serve/")
+    EXEMPT = ("sav_tpu/ops/quant.py",)
+    INT8_DTYPES = frozenset({"jax.numpy.int8", "numpy.int8"})
+    ARRAY_CTORS = frozenset(
+        {
+            "jax.numpy.asarray", "jax.numpy.array", "jax.numpy.full",
+            "jax.numpy.zeros", "jax.numpy.ones", "numpy.asarray",
+            "numpy.array",
+        }
+    )
+
+    def _is_int8(self, module, node) -> bool:
+        if isinstance(node, ast.Constant) and node.value == "int8":
+            return True
+        return module.resolve(node) in self.INT8_DTYPES
+
+    def check(self, module):
+        if (
+            not module.relpath.startswith(self.SCOPE)
+            or module.relpath in self.EXEMPT
+        ):
+            return
+        for node in ast.walk(module.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            dtype_nodes = [
+                kw.value for kw in node.keywords if kw.arg == "dtype"
+            ]
+            if (
+                isinstance(node.func, ast.Attribute)
+                and node.func.attr == "astype"
+            ):
+                dtype_nodes += node.args[:1]
+                what = ".astype(int8)"
+            elif module.resolve_call(node) in self.ARRAY_CTORS:
+                # asarray/array take dtype positionally second; the
+                # zeros/ones/full family keyword-only in this repo's
+                # idiom (positional shapes) — the dtype kwarg covers it.
+                dtype_nodes += node.args[1:2]
+                what = f"{node.func.attr}(..., int8)"
+            else:
+                continue
+            if any(self._is_int8(module, d) for d in dtype_nodes):
+                yield _finding(
+                    self,
+                    node,
+                    f"unscaled int8 cast ({what}) outside "
+                    "sav_tpu/ops/quant.py — an int8 tensor with no "
+                    "per-channel scale wraps/truncates silently",
+                )
+
+
 # ----------------------------------------------------------- SAV100 (meta)
 
 
@@ -1460,6 +1543,7 @@ ALL_RULES = [
     AdhocPartitionSpec(),
     RouterHotPathSync(),
     RouterTraceHotPathSync(),
+    UnscaledInt8Cast(),
 ]
 
 

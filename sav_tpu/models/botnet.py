@@ -13,6 +13,7 @@ separate ``base_with_state.py`` trainer; here one trainer handles both).
 
 from __future__ import annotations
 
+import functools
 from typing import Any, Callable, Optional
 
 import flax.linen as nn
@@ -20,6 +21,7 @@ import jax
 import jax.numpy as jnp
 
 from sav_tpu.models.layers import BoTMHSA, SqueezeExciteBlock
+from sav_tpu.ops.quant import QuantDense
 
 Dtype = Any
 
@@ -72,6 +74,10 @@ class BoTBlock(nn.Module):
     activation_fn: Callable = nn.swish
     backend: Optional[str] = None
     logits_dtype: Optional[Dtype] = None  # None = inherit dtype (softmax math)
+    # int8 quantized MHSA Q/K/V projections; the 1×1 convs + BNs stay
+    # in ``dtype`` (conv-dominated — see docs/quantization.md on why
+    # BoTNet's HBM win is head+projection-sized only).
+    quant: Optional[str] = None
     dtype: Dtype = jnp.float32
 
     @nn.compact
@@ -95,6 +101,7 @@ class BoTBlock(nn.Module):
             head_ch=self.filters // self.num_heads,
             backend=self.backend,
             logits_dtype=self.logits_dtype,
+            quant=self.quant,
             dtype=self.dtype,
             name="mhsa",
         )(x)
@@ -117,6 +124,7 @@ class BoTNet(nn.Module):
     activation_fn: Callable = nn.swish
     backend: Optional[str] = None
     logits_dtype: Optional[Dtype] = None  # None = inherit dtype (softmax math)
+    quant: Optional[str] = None  # see BoTBlock.quant
     dtype: Dtype = jnp.float32
 
     @nn.compact
@@ -151,12 +159,17 @@ class BoTNet(nn.Module):
                 activation_fn=self.activation_fn,
                 backend=self.backend,
                 logits_dtype=self.logits_dtype,
+                quant=self.quant,
                 dtype=self.dtype,
                 name=f"stage4_block{block}",
             )(x, is_training)
 
         x = jnp.mean(x, axis=(1, 2))
-        return nn.Dense(
+        head = (
+            functools.partial(QuantDense, mode=self.quant)
+            if self.quant else nn.Dense
+        )
+        return head(
             self.num_classes,
             kernel_init=nn.initializers.zeros,
             dtype=self.dtype,

@@ -9,6 +9,7 @@ fp32) is fixed: dtype threads through every block.
 
 from __future__ import annotations
 
+import functools
 from typing import Any, Optional
 
 import flax.linen as nn
@@ -24,6 +25,7 @@ from sav_tpu.models.layers import (
     SelfAttentionBlock,
     StochasticDepthBlock,
 )
+from sav_tpu.ops.quant import QuantDense
 
 Dtype = Any
 
@@ -41,6 +43,9 @@ class EncoderBlock(nn.Module):
     logits_dtype: Optional[Dtype] = None  # None = inherit dtype (softmax math)
     seq_parallel: Optional[str] = None  # 'ring' only (talking-heads trunk)
     seq_mesh: Optional[Any] = None
+    # int8 quantized projection/FFN dots; the talking-heads mixing
+    # kernels ([H, H], tiny) and the attention core stay in ``dtype``.
+    quant: Optional[str] = None
     dtype: Dtype = jnp.float32
 
     @nn.compact
@@ -55,6 +60,7 @@ class EncoderBlock(nn.Module):
             logits_dtype=self.logits_dtype,
             seq_parallel=self.seq_parallel,
             seq_mesh=self.seq_mesh,
+            quant=self.quant,
             dtype=self.dtype,
         )(x, is_training)
         x = LayerScaleBlock(eps=self.layerscale_eps, dtype=self.dtype)(x)
@@ -64,6 +70,7 @@ class EncoderBlock(nn.Module):
         y = FFBlock(
             expand_ratio=self.expand_ratio,
             dropout_rate=self.dropout_rate,
+            quant=self.quant,
             dtype=self.dtype,
         )(y, is_training)
         y = LayerScaleBlock(eps=self.layerscale_eps, dtype=self.dtype)(y)
@@ -82,6 +89,7 @@ class CAEncoderBlock(nn.Module):
     dropout_rate: float = 0.0
     backend: Optional[str] = None
     logits_dtype: Optional[Dtype] = None  # None = inherit dtype (softmax math)
+    quant: Optional[str] = None  # see EncoderBlock.quant
     dtype: Dtype = jnp.float32
 
     @nn.compact
@@ -96,6 +104,7 @@ class CAEncoderBlock(nn.Module):
             out_dropout_rate=self.dropout_rate,
             backend=self.backend,
             logits_dtype=self.logits_dtype,
+            quant=self.quant,
             dtype=self.dtype,
         )(x, is_training)
         x = LayerScaleBlock(eps=self.layerscale_eps, dtype=self.dtype)(x)
@@ -105,6 +114,7 @@ class CAEncoderBlock(nn.Module):
         y = FFBlock(
             expand_ratio=self.expand_ratio,
             dropout_rate=self.dropout_rate,
+            quant=self.quant,
             dtype=self.dtype,
         )(y, is_training)
         y = LayerScaleBlock(eps=self.layerscale_eps, dtype=self.dtype)(y)
@@ -133,6 +143,7 @@ class CaiT(nn.Module):
     # shard away.
     seq_parallel: Optional[str] = None
     seq_mesh: Optional[Any] = None
+    quant: Optional[str] = None  # see EncoderBlock.quant
     dtype: Dtype = jnp.float32
 
     @nn.compact
@@ -154,6 +165,7 @@ class CaiT(nn.Module):
                 logits_dtype=self.logits_dtype,
                 seq_parallel=self.seq_parallel,
                 seq_mesh=self.seq_mesh,
+                quant=self.quant,
                 dtype=self.dtype,
                 name=f"block_{i}",
             )(x, is_training)
@@ -173,12 +185,17 @@ class CaiT(nn.Module):
                 dropout_rate=self.dropout_rate,
                 backend=self.backend,
                 logits_dtype=self.logits_dtype,
+                quant=self.quant,
                 dtype=self.dtype,
                 name=f"ca_block_{i}",
             )(cls_tok, x, is_training)
 
         out = nn.LayerNorm(dtype=self.dtype)(cls_tok[:, 0])
-        return nn.Dense(
+        head = (
+            functools.partial(QuantDense, mode=self.quant)
+            if self.quant else nn.Dense
+        )
+        return head(
             self.num_classes,
             kernel_init=nn.initializers.zeros,
             dtype=self.dtype,

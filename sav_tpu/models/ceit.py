@@ -11,6 +11,7 @@ the bare LC attention + FF the reference actually runs.
 
 from __future__ import annotations
 
+import functools
 from typing import Any, Optional
 
 import flax.linen as nn
@@ -24,6 +25,7 @@ from sav_tpu.models.layers import (
     LeFFBlock,
     SelfAttentionBlock,
 )
+from sav_tpu.ops.quant import QuantDense
 
 Dtype = Any
 
@@ -39,6 +41,9 @@ class EncoderBlock(nn.Module):
     logits_dtype: Optional[Dtype] = None  # None = inherit dtype (softmax math)
     seq_parallel: Optional[str] = None
     seq_mesh: Optional[Any] = None
+    # int8 quantized projection dots + LeFF expand/project dots; the
+    # LeFF depthwise conv and all norms stay in ``dtype``.
+    quant: Optional[str] = None
     dtype: Dtype = jnp.float32
 
     @nn.compact
@@ -51,10 +56,13 @@ class EncoderBlock(nn.Module):
             logits_dtype=self.logits_dtype,
             seq_parallel=self.seq_parallel,
             seq_mesh=self.seq_mesh,
+            quant=self.quant,
             dtype=self.dtype,
         )(inputs, is_training)
         x = nn.LayerNorm(dtype=self.dtype)(x + inputs)
-        y = LeFFBlock(expand_ratio=self.expand_ratio, dtype=self.dtype)(x, is_training)
+        y = LeFFBlock(
+            expand_ratio=self.expand_ratio, quant=self.quant, dtype=self.dtype
+        )(x, is_training)
         return nn.LayerNorm(dtype=self.dtype)(y + x)
 
 
@@ -74,6 +82,7 @@ class CeiT(nn.Module):
     # attention over L_layers CLS tokens) stays unsharded.
     seq_parallel: Optional[str] = None
     seq_mesh: Optional[Any] = None
+    quant: Optional[str] = None  # see EncoderBlock.quant
     dtype: Dtype = jnp.float32
 
     @nn.compact
@@ -102,6 +111,7 @@ class CeiT(nn.Module):
                 logits_dtype=self.logits_dtype,
                 seq_parallel=self.seq_parallel,
                 seq_mesh=self.seq_mesh,
+                quant=self.quant,
                 dtype=self.dtype,
                 name=f"block_{i}",
             )(x, is_training)
@@ -115,11 +125,16 @@ class CeiT(nn.Module):
             attn_dropout_rate=self.attn_dropout_rate,
             backend=self.backend,
             logits_dtype=self.logits_dtype,
+            quant=self.quant,
             dtype=self.dtype,
             name="lca",
         )(cls_seq, is_training)
         out = nn.LayerNorm(dtype=self.dtype)(out[:, -1])
-        return nn.Dense(
+        head = (
+            functools.partial(QuantDense, mode=self.quant)
+            if self.quant else nn.Dense
+        )
+        return head(
             self.num_classes,
             kernel_init=nn.initializers.zeros,
             dtype=self.dtype,

@@ -10,6 +10,7 @@ carried alongside the grid instead of being zero-padded into it (cvt.py:10-16,
 
 from __future__ import annotations
 
+import functools
 from typing import Any, Optional
 
 import flax.linen as nn
@@ -17,6 +18,7 @@ import jax
 import jax.numpy as jnp
 
 from sav_tpu.models.layers import CvTSelfAttentionBlock, FFBlock
+from sav_tpu.ops.quant import QuantDense
 
 Dtype = Any
 
@@ -54,6 +56,9 @@ class StageBlock(nn.Module):
     dropout_rate: float = 0.0
     backend: Optional[str] = None
     logits_dtype: Optional[Dtype] = None  # None = inherit dtype (softmax math)
+    # int8 quantized pointwise projection/FFN dots; the conv token
+    # embeds and depthwise convs stay in ``dtype``.
+    quant: Optional[str] = None
     dtype: Dtype = jnp.float32
 
     @nn.compact
@@ -68,6 +73,7 @@ class StageBlock(nn.Module):
             out_dropout_rate=self.dropout_rate,
             backend=self.backend,
             logits_dtype=self.logits_dtype,
+            quant=self.quant,
             dtype=self.dtype,
         )(x, grid_shape, is_training)
         tokens = tokens + x
@@ -75,6 +81,7 @@ class StageBlock(nn.Module):
         y = FFBlock(
             expand_ratio=self.expand_ratio,
             dropout_rate=self.dropout_rate,
+            quant=self.quant,
             dtype=self.dtype,
         )(y, is_training)
         return tokens + y
@@ -94,6 +101,7 @@ class Stage(nn.Module):
     dropout_rate: float = 0.0
     backend: Optional[str] = None
     logits_dtype: Optional[Dtype] = None  # None = inherit dtype (softmax math)
+    quant: Optional[str] = None  # see StageBlock.quant
     dtype: Dtype = jnp.float32
 
     @nn.compact
@@ -119,6 +127,7 @@ class Stage(nn.Module):
                 dropout_rate=self.dropout_rate,
                 backend=self.backend,
                 logits_dtype=self.logits_dtype,
+                quant=self.quant,
                 dtype=self.dtype,
                 name=f"block_{i}",
             )(tokens, grid_shape, is_training)
@@ -137,6 +146,7 @@ class CvT(nn.Module):
     dropout_rate: float = 0.0
     backend: Optional[str] = None
     logits_dtype: Optional[Dtype] = None  # None = inherit dtype (softmax math)
+    quant: Optional[str] = None  # see StageBlock.quant
     dtype: Dtype = jnp.float32
 
     @nn.compact
@@ -157,6 +167,7 @@ class CvT(nn.Module):
                 dropout_rate=self.dropout_rate,
                 backend=self.backend,
                 logits_dtype=self.logits_dtype,
+                quant=self.quant,
                 dtype=self.dtype,
                 name=f"stage_{s}",
             )(x, is_training)
@@ -167,7 +178,11 @@ class CvT(nn.Module):
                 x = tokens.reshape(b, h, w, self.embed_dims[s])
 
         out = nn.LayerNorm(dtype=self.dtype)(tokens[:, 0])
-        return nn.Dense(
+        head = (
+            functools.partial(QuantDense, mode=self.quant)
+            if self.quant else nn.Dense
+        )
+        return head(
             self.num_classes,
             kernel_init=nn.initializers.zeros,
             dtype=self.dtype,

@@ -23,6 +23,12 @@ import jax
 import jax.numpy as jnp
 
 from sav_tpu.ops.attention import dot_product_attention
+from sav_tpu.ops.quant import (
+    QuantDenseGeneral,
+    int8_serve_dot,
+    int8_ste_dot,
+    quant_rng_data,
+)
 from sav_tpu.ops.rotary import apply_rotary_pos_emb, fixed_positional_embedding
 
 Dtype = Any
@@ -105,6 +111,11 @@ class _FusedQKVProj(nn.Module):
     num_heads: int
     head_ch: int
     use_bias: bool = False
+    # int8 quant arm (sav_tpu/ops/quant.py): "int8" routes each slice
+    # einsum through the STE dot; "int8_serve" declares the stacked
+    # kernel as int8 + a per-slice-channel scale. The per-slice compute
+    # structure (and the TP-friendly param slicing) is unchanged.
+    quant: Optional[str] = None
     dtype: Dtype = jnp.float32
 
     @nn.compact
@@ -119,17 +130,36 @@ class _FusedQKVProj(nn.Module):
             flat = nn.initializers.lecun_normal()(rng, (in_ch, 3 * hd), param_dtype)
             return flat.reshape(shape)
 
-        kernel = self.param("kernel", kernel_init, (in_ch, 3, h, d), jnp.float32)
-        kernel = kernel.astype(self.dtype)
+        if self.quant == "int8_serve":
+            kernel = self.param(
+                "kernel", nn.initializers.zeros_init(), (in_ch, 3, h, d), jnp.int8
+            )
+            scale = self.param(
+                "scale", nn.initializers.ones_init(), (3, h, d), jnp.float32
+            )
+        else:
+            kernel = self.param("kernel", kernel_init, (in_ch, 3, h, d), jnp.float32)
+            kernel = kernel.astype(self.dtype)
         xc = x.astype(self.dtype)
         if self.use_bias:
             bias = self.param(
                 "bias", nn.initializers.zeros_init(), (3, h, d), jnp.float32
             ).astype(self.dtype)
 
-        def proj(t):
-            y = jnp.einsum("...i,ihd->...hd", xc, kernel[:, t])
-            return y + bias[t] if self.use_bias else y
+        if self.quant == "int8_serve":
+            def proj(t):
+                y = int8_serve_dot(xc, kernel[:, t], scale[t], 1).astype(self.dtype)
+                return y + bias[t] if self.use_bias else y
+        elif self.quant:
+            qkey = quant_rng_data(self)
+
+            def proj(t):
+                y = int8_ste_dot(xc, kernel[:, t], jax.random.fold_in(qkey, t), 1)
+                return y + bias[t] if self.use_bias else y
+        else:
+            def proj(t):
+                y = jnp.einsum("...i,ihd->...hd", xc, kernel[:, t])
+                return y + bias[t] if self.use_bias else y
 
         return proj(0), proj(1), proj(2)
 
@@ -173,6 +203,11 @@ class AttentionBlock(nn.Module):
     # CLS-odd sequence lengths of the model zoo (pad-and-mask).
     seq_parallel: Optional[str] = None
     seq_mesh: Optional[Any] = None
+    # int8 quantized projection dots ("int8" QAT / "int8_serve" — see
+    # sav_tpu/ops/quant.py): Q/K/V and the output merge route through
+    # the quantized dot; the attention core (QK/AV) stays in ``dtype``
+    # by design (PERF §5: those dots are not matmul-roofline-bound).
+    quant: Optional[str] = None
     dtype: Dtype = jnp.float32
 
     @nn.compact
@@ -185,10 +220,11 @@ class AttentionBlock(nn.Module):
         scale = head_ch**-0.5
 
         dense = functools.partial(
-            nn.DenseGeneral,
+            QuantDenseGeneral if self.quant else nn.DenseGeneral,
             axis=-1,
             use_bias=self.use_bias,
             dtype=self.dtype,
+            **({"mode": self.quant} if self.quant else {}),
         )
         if self.fused_qkv:
             # Self-attention: one stacked [in, 3, H, D] parameter, computed
@@ -206,6 +242,7 @@ class AttentionBlock(nn.Module):
                 num_heads=self.num_heads,
                 head_ch=head_ch,
                 use_bias=self.use_bias,
+                quant=self.quant,
                 dtype=self.dtype,
                 name="to_qkv",
             )(inputs_q)
@@ -362,11 +399,9 @@ class AttentionBlock(nn.Module):
                 logits_dtype=self.logits_dtype or self.dtype,
             )
 
-        out = nn.DenseGeneral(
+        out = dense(
             features=out_ch,
             axis=(-2, -1),
-            use_bias=self.use_bias,
-            dtype=self.dtype,
             name="to_out",
         )(out)
         out = nn.Dropout(rate=self.out_dropout_rate)(out, deterministic=not is_training)

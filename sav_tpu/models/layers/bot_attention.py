@@ -21,6 +21,7 @@ import jax.numpy as jnp
 
 from sav_tpu.ops.attention import dot_product_attention
 from sav_tpu.ops.flash_attention import flash_botnet_attention
+from sav_tpu.ops.quant import QuantDenseGeneral
 from sav_tpu.ops.relative import relative_logits_2d
 
 Dtype = Any
@@ -38,6 +39,9 @@ class BoTMHSA(nn.Module):
     pos_emb_init_stddev: Optional[float] = None
     backend: Optional[str] = None
     logits_dtype: Optional[Dtype] = None  # None = inherit dtype (softmax math)
+    # int8 quantized Q/K/V projections (sav_tpu/ops/quant.py); the
+    # relative-logits tables and the attention core stay in ``dtype``.
+    quant: Optional[str] = None
     dtype: Dtype = jnp.float32
 
     @nn.compact
@@ -47,7 +51,10 @@ class BoTMHSA(nn.Module):
         inner = self.num_heads * head_ch
         scale = head_ch**-0.5
 
-        dense = lambda name: nn.DenseGeneral(
+        proj_cls = (
+            lambda **kw: QuantDenseGeneral(mode=self.quant, **kw)
+        ) if self.quant else nn.DenseGeneral
+        dense = lambda name: proj_cls(
             features=(self.num_heads, head_ch),
             axis=-1,
             use_bias=False,

@@ -27,6 +27,7 @@ import jax.numpy as jnp
 from sav_tpu.models.layers.attention import talking_heads_attention
 from sav_tpu.models.layers.depthwise import DepthwiseConv2D
 from sav_tpu.ops.attention import dot_product_attention
+from sav_tpu.ops.quant import QuantDenseGeneral
 
 Dtype = Any
 
@@ -43,6 +44,10 @@ class ConvProjectionBlock(nn.Module):
     stride: int = 1
     use_bias: bool = False
     with_cls: bool = False
+    # int8 quant arm: the pointwise head projection routes through
+    # sav_tpu/ops/quant.py; the depthwise conv + BN stay in ``dtype``
+    # (convs already map optimally to the MXU — module docstring).
+    quant: Optional[str] = None
     dtype: Dtype = jnp.float32
 
     @nn.compact
@@ -72,7 +77,11 @@ class ConvProjectionBlock(nn.Module):
         x = x.reshape(b, -1, ch)
         if cls_tok is not None:
             x = jnp.concatenate([cls_tok, x], axis=1)
-        return nn.DenseGeneral(
+        pointwise = (
+            functools.partial(QuantDenseGeneral, mode=self.quant)
+            if self.quant else nn.DenseGeneral
+        )
+        return pointwise(
             features=(self.num_heads, self.head_ch),
             axis=-1,
             use_bias=self.use_bias,
@@ -95,6 +104,9 @@ class CvTAttentionBlock(nn.Module):
     with_cls: bool = False
     backend: Optional[str] = None
     logits_dtype: Optional[Dtype] = None  # None = inherit dtype (softmax math)
+    # int8 quantized projection dots (pointwise Q/K/V + output merge);
+    # the attention core and the depthwise convs stay in ``dtype``.
+    quant: Optional[str] = None
     dtype: Dtype = jnp.float32
 
     @nn.compact
@@ -112,6 +124,7 @@ class CvTAttentionBlock(nn.Module):
             head_ch=head_ch,
             use_bias=self.use_bias,
             with_cls=self.with_cls,
+            quant=self.quant,
             dtype=self.dtype,
         )
         sq, sk, sv = self.strides
@@ -147,7 +160,11 @@ class CvTAttentionBlock(nn.Module):
                 logits_dtype=self.logits_dtype or self.dtype,
             )
 
-        out = nn.DenseGeneral(
+        out_dense = (
+            functools.partial(QuantDenseGeneral, mode=self.quant)
+            if self.quant else nn.DenseGeneral
+        )
+        out = out_dense(
             features=out_ch,
             axis=(-2, -1),
             use_bias=self.use_bias,

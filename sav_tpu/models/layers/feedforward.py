@@ -6,6 +6,7 @@ LeFFBlock (/root/reference/models/layers/feedforwards/leff.py:9-63).
 
 from __future__ import annotations
 
+import functools
 from typing import Any, Callable, Optional
 
 import flax.linen as nn
@@ -13,6 +14,7 @@ import jax
 import jax.numpy as jnp
 
 from sav_tpu.models.layers.depthwise import DepthwiseConv2D
+from sav_tpu.ops.quant import QuantDense
 
 Dtype = Any
 
@@ -25,16 +27,23 @@ class FFBlock(nn.Module):
     dropout_rate: float = 0.0
     activation_fn: Callable = nn.gelu
     use_bias: bool = True
+    # int8 quantized dots ("int8" QAT / "int8_serve") — both FFN
+    # matmuls route through sav_tpu/ops/quant.py; None = plain nn.Dense.
+    quant: Optional[str] = None
     dtype: Dtype = jnp.float32
 
     @nn.compact
     def __call__(self, inputs: jax.Array, is_training: bool) -> jax.Array:
         in_ch = inputs.shape[-1]
         hidden = self.hidden_ch or int(in_ch * self.expand_ratio)
-        x = nn.Dense(hidden, use_bias=self.use_bias, dtype=self.dtype, name="fc1")(inputs)
+        dense = (
+            functools.partial(QuantDense, mode=self.quant)
+            if self.quant else nn.Dense
+        )
+        x = dense(hidden, use_bias=self.use_bias, dtype=self.dtype, name="fc1")(inputs)
         x = self.activation_fn(x)
         x = nn.Dropout(rate=self.dropout_rate)(x, deterministic=not is_training)
-        x = nn.Dense(in_ch, use_bias=self.use_bias, dtype=self.dtype, name="fc2")(x)
+        x = dense(in_ch, use_bias=self.use_bias, dtype=self.dtype, name="fc2")(x)
         x = nn.Dropout(rate=self.dropout_rate)(x, deterministic=not is_training)
         return x
 
@@ -51,12 +60,19 @@ class LeFFBlock(nn.Module):
     hidden_ch: Optional[int] = None
     kernel_size: tuple[int, int] = (5, 5)
     activation_fn: Callable = nn.gelu
+    # int8 quantized expand/project dots; the depthwise conv and the
+    # BatchNorms stay in ``dtype`` (conv is not a projection/FFN dot).
+    quant: Optional[str] = None
     dtype: Dtype = jnp.float32
 
     @nn.compact
     def __call__(self, inputs: jax.Array, is_training: bool) -> jax.Array:
         in_ch = inputs.shape[-1]
         hidden = self.hidden_ch or int(in_ch * self.expand_ratio)
+        dense = (
+            functools.partial(QuantDense, mode=self.quant)
+            if self.quant else nn.Dense
+        )
         cls_tok, tokens = inputs[:, :1], inputs[:, 1:]
         b, l, _ = tokens.shape
         side = int(round(l**0.5))
@@ -66,7 +82,7 @@ class LeFFBlock(nn.Module):
         norm = lambda name: nn.BatchNorm(
             use_running_average=not is_training, momentum=0.9, dtype=self.dtype, name=name
         )
-        x = nn.Dense(hidden, dtype=self.dtype, name="expand")(tokens)
+        x = dense(hidden, dtype=self.dtype, name="expand")(tokens)
         x = self.activation_fn(norm("bn1")(x))
         x = x.reshape(b, side, side, hidden)
         # Shifted-FMA depthwise (param-compatible with the nn.Conv grouped
@@ -79,6 +95,6 @@ class LeFFBlock(nn.Module):
         )(x)
         x = self.activation_fn(norm("bn2")(x))
         x = x.reshape(b, l, hidden)
-        x = nn.Dense(in_ch, dtype=self.dtype, name="project")(x)
+        x = dense(in_ch, dtype=self.dtype, name="project")(x)
         x = self.activation_fn(norm("bn3")(x))
         return jnp.concatenate([cls_tok, x], axis=1)

@@ -2,13 +2,15 @@
 
 from __future__ import annotations
 
-from typing import Any
+import functools
+from typing import Any, Optional
 
 import flax.linen as nn
 import jax
 import jax.numpy as jnp
 
 from sav_tpu.models.layers import FFBlock, PatchEmbedBlock
+from sav_tpu.ops.quant import QuantDense
 
 Dtype = Any
 
@@ -19,6 +21,9 @@ class MixerBlock(nn.Module):
     tokens_hidden_ch: int
     channels_hidden_ch: int
     dropout_rate: float = 0.0
+    # int8 quantized mixing MLPs (both token- and channel-mixing dots
+    # route through sav_tpu/ops/quant.py).
+    quant: Optional[str] = None
     dtype: Dtype = jnp.float32
 
     @nn.compact
@@ -28,6 +33,7 @@ class MixerBlock(nn.Module):
         x = FFBlock(
             hidden_ch=self.tokens_hidden_ch,
             dropout_rate=self.dropout_rate,
+            quant=self.quant,
             dtype=self.dtype,
             name="token_mixing",
         )(x, is_training)
@@ -37,6 +43,7 @@ class MixerBlock(nn.Module):
         y = FFBlock(
             hidden_ch=self.channels_hidden_ch,
             dropout_rate=self.dropout_rate,
+            quant=self.quant,
             dtype=self.dtype,
             name="channel_mixing",
         )(y, is_training)
@@ -51,6 +58,7 @@ class MLPMixer(nn.Module):
     channels_hidden_ch: int
     patch_shape: tuple[int, int]
     dropout_rate: float = 0.0
+    quant: Optional[str] = None  # see MixerBlock.quant
     dtype: Dtype = jnp.float32
 
     @nn.compact
@@ -63,12 +71,17 @@ class MLPMixer(nn.Module):
                 tokens_hidden_ch=self.tokens_hidden_ch,
                 channels_hidden_ch=self.channels_hidden_ch,
                 dropout_rate=self.dropout_rate,
+                quant=self.quant,
                 dtype=self.dtype,
                 name=f"block_{i}",
             )(x, is_training)
         x = nn.LayerNorm(dtype=self.dtype)(x)
         x = jnp.mean(x, axis=1)
-        return nn.Dense(
+        head = (
+            functools.partial(QuantDense, mode=self.quant)
+            if self.quant else nn.Dense
+        )
+        return head(
             self.num_classes,
             kernel_init=nn.initializers.zeros,
             dtype=self.dtype,

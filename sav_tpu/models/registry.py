@@ -161,6 +161,7 @@ def create_model(
     seq_parallel: Optional[str] = None,
     seq_mesh=None,
     layout=None,
+    quant: Optional[str] = None,
     **overrides,
 ):
     """Instantiate a named model config.
@@ -180,6 +181,12 @@ def create_model(
         stream, CeiT trunk — others raise).
       seq_mesh: the jax.sharding.Mesh carrying the 'seq' axis; required
         with ``seq_parallel``.
+      quant: int8 quantized projection/FFN dots (sav_tpu/ops/quant.py):
+        "int8" (AQT-style QAT training arm) or "int8_serve" (int8
+        weights + per-channel scales, the quantized serving tree) —
+        threaded to every projection/FFN/head dot in every family; the
+        attention QK/AV core stays in ``dtype`` (PERF §5). None = the
+        plain float path, byte-identical param tree to before.
       layout: a :class:`~sav_tpu.parallel.layout.BoundLayout` threaded to
         models with a layout seam (ViT family): encoder blocks pin token
         activations to the layout's activation spec — the 2D-TP
@@ -201,6 +208,14 @@ def create_model(
         merged["logits_dtype"] = logits_dtype
     if layout is not None and "layout" in cls.__dataclass_fields__:
         merged["layout"] = layout
+    if quant is not None:
+        if "quant" not in cls.__dataclass_fields__:
+            raise ValueError(
+                f"{model_name!r} does not support the int8 quant arm "
+                "(every registered family does — a custom class must "
+                "declare a 'quant' field to opt in)"
+            )
+        merged["quant"] = quant
     if seq_parallel is not None:
         if "seq_parallel" not in cls.__dataclass_fields__:
             raise ValueError(

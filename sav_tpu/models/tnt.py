@@ -12,6 +12,7 @@ registry.
 
 from __future__ import annotations
 
+import functools
 from typing import Any, Optional
 
 import flax.linen as nn
@@ -24,6 +25,7 @@ from sav_tpu.models.layers import (
     PatchEmbedBlock,
     SelfAttentionBlock,
 )
+from sav_tpu.ops.quant import QuantDense
 
 Dtype = Any
 
@@ -90,6 +92,10 @@ class EncoderBlock(nn.Module):
     # per-patch sequences are tiny and already parallel over B*P.
     seq_parallel: Optional[str] = None
     seq_mesh: Optional[Any] = None
+    # int8 quantized projection/FFN dots on BOTH streams; the fold
+    # projection (Inner2OuterBlock) stays float — it runs once per block
+    # on tiny flattened tokens and its output seeds a residual stream.
+    quant: Optional[str] = None
     dtype: Dtype = jnp.float32
 
     @nn.compact
@@ -104,6 +110,7 @@ class EncoderBlock(nn.Module):
             out_dropout_rate=self.dropout_rate,
             backend=self.backend,
             logits_dtype=self.logits_dtype,
+            quant=self.quant,
             dtype=self.dtype,
             name="inner_attn",
         )(x, is_training)
@@ -112,6 +119,7 @@ class EncoderBlock(nn.Module):
         y = FFBlock(
             expand_ratio=self.inner_expand_ratio,
             dropout_rate=self.dropout_rate,
+            quant=self.quant,
             dtype=self.dtype,
             name="inner_ff",
         )(y, is_training)
@@ -131,6 +139,7 @@ class EncoderBlock(nn.Module):
             logits_dtype=self.logits_dtype,
             seq_parallel=self.seq_parallel,
             seq_mesh=self.seq_mesh,
+            quant=self.quant,
             dtype=self.dtype,
             name="outer_attn",
         )(z, is_training)
@@ -139,6 +148,7 @@ class EncoderBlock(nn.Module):
         w = FFBlock(
             expand_ratio=self.expand_ratio,
             dropout_rate=self.dropout_rate,
+            quant=self.quant,
             dtype=self.dtype,
             name="outer_ff",
         )(w, is_training)
@@ -163,6 +173,7 @@ class TNT(nn.Module):
     logits_dtype: Optional[Dtype] = None  # None = inherit dtype (softmax math)
     seq_parallel: Optional[str] = None  # outer-stream SP; see EncoderBlock
     seq_mesh: Optional[Any] = None
+    quant: Optional[str] = None  # see EncoderBlock.quant
     dtype: Dtype = jnp.float32
 
     @nn.compact
@@ -204,12 +215,17 @@ class TNT(nn.Module):
                 logits_dtype=self.logits_dtype,
                 seq_parallel=self.seq_parallel,
                 seq_mesh=self.seq_mesh,
+                quant=self.quant,
                 dtype=self.dtype,
                 name=f"block_{i}",
             )(pixel_tokens, patch_tokens, is_training)
 
         out = nn.LayerNorm(dtype=self.dtype)(patch_tokens[:, 0])
-        return nn.Dense(
+        head = (
+            functools.partial(QuantDense, mode=self.quant)
+            if self.quant else nn.Dense
+        )
+        return head(
             self.num_classes,
             kernel_init=nn.initializers.zeros,
             dtype=self.dtype,

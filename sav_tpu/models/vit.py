@@ -7,6 +7,7 @@ attention core running on the backend-dispatched Pallas/XLA seam.
 
 from __future__ import annotations
 
+import functools
 from typing import Any, Optional
 
 import flax.linen as nn
@@ -21,6 +22,7 @@ from sav_tpu.models.layers import (
     SelfAttentionBlock,
 )
 from sav_tpu.models.layers.moe import MoEFFBlock
+from sav_tpu.ops.quant import QuantDense
 
 Dtype = Any
 
@@ -44,6 +46,9 @@ class EncoderBlock(nn.Module):
     # tokens to the layout's activation spec — the 2D-TP between-block
     # constraint. None (the default and every 1D/DP run) is a no-op.
     layout: Optional[Any] = None
+    # int8 quantized projection/FFN dots ("int8" QAT / "int8_serve" —
+    # sav_tpu/ops/quant.py); the attention core stays in ``dtype``.
+    quant: Optional[str] = None
     dtype: Dtype = jnp.float32
 
     @nn.compact
@@ -58,6 +63,7 @@ class EncoderBlock(nn.Module):
             logits_dtype=self.logits_dtype,
             seq_parallel=self.seq_parallel,
             seq_mesh=self.seq_mesh,
+            quant=self.quant,
             dtype=self.dtype,
         )(x, is_training)
         x = x + inputs
@@ -75,6 +81,7 @@ class EncoderBlock(nn.Module):
             y = FFBlock(
                 expand_ratio=self.expand_ratio,
                 dropout_rate=self.dropout_rate,
+                quant=self.quant,
                 dtype=self.dtype,
             )(y, is_training)
         from sav_tpu.parallel.layout import constrain_tokens
@@ -108,6 +115,7 @@ class Encoder(nn.Module):
     seq_parallel: Optional[str] = None  # 'ring'|'ulysses' over seq_mesh
     seq_mesh: Optional[Any] = None
     layout: Optional[Any] = None  # see EncoderBlock.layout
+    quant: Optional[str] = None  # see EncoderBlock.quant
     dtype: Dtype = jnp.float32
 
     @nn.compact
@@ -145,6 +153,7 @@ class Encoder(nn.Module):
                 seq_parallel=self.seq_parallel,
                 seq_mesh=self.seq_mesh,
                 layout=self.layout,
+                quant=self.quant,
                 dtype=self.dtype,
                 name=f"block_{i}",
             )(x, is_training)
@@ -173,6 +182,9 @@ class ViT(nn.Module):
     seq_parallel: Optional[str] = None  # 'ring'|'ulysses' over seq_mesh
     seq_mesh: Optional[Any] = None
     layout: Optional[Any] = None  # see EncoderBlock.layout
+    # int8 quant arm: encoder projections/FFNs + the classifier head;
+    # the patch embed conv and pos embeds stay in ``dtype``.
+    quant: Optional[str] = None
     dtype: Dtype = jnp.float32
 
     @nn.compact
@@ -201,10 +213,15 @@ class ViT(nn.Module):
             seq_parallel=self.seq_parallel,
             seq_mesh=self.seq_mesh,
             layout=self.layout,
+            quant=self.quant,
             dtype=self.dtype,
         )(x, is_training)
         cls_out = x[:, 0]
-        return nn.Dense(
+        head = (
+            functools.partial(QuantDense, mode=self.quant)
+            if self.quant else nn.Dense
+        )
+        return head(
             self.num_classes,
             kernel_init=nn.initializers.zeros,
             dtype=self.dtype,

@@ -52,6 +52,27 @@ CPU_FAKE_PEAK_FLOPS = 1.0e12
 # Forward+backward multiplier over forward matmul FLOPs.
 TRAIN_STEP_MULTIPLIER = 3.0
 
+# ---- dot-dtype axis (ISSUE 17). The cost model's traffic and roofline
+# numbers are dtype-dependent once the int8 arm exists: an int8 dot
+# moves 1 byte/element where bf16 moves 2, and the MXU's int8 pipe peaks
+# at 2x its bf16 FLOP/s (the TPU generations the peak table knows all
+# share the 2:1 int8:bf16 ratio; the same convention the AQT paper's
+# speedups are quoted against). ``None`` keys mean "whatever the compute
+# dtype was" — the pre-quant behavior, so existing callers are unchanged.
+DOT_DTYPE_BYTES = {"f32": 4, "float32": 4, "bf16": 2, "bfloat16": 2, "int8": 1}
+
+# Peak-FLOP/s multiplier over the table's (bf16) number, per dot dtype.
+DOT_DTYPE_PEAK_FACTOR = {"bf16": 1.0, "bfloat16": 1.0, "f32": 1.0,
+                         "float32": 1.0, "int8": 2.0}
+
+
+def dot_dtype_bytes(dot_dtype: Optional[str], default: int = 2) -> int:
+    """Bytes per element moved by a dot of the named dtype (``None`` =
+    ``default``, the caller's compute-dtype width)."""
+    if dot_dtype is None:
+        return default
+    return DOT_DTYPE_BYTES.get(str(dot_dtype).lower(), default)
+
 # Attribution component names (the gauge/manifest vocabulary). The
 # analytic walk buckets every parameter into one of these; QK/AV is the
 # parameter-free attention einsum pair, ATTN_PROJ the qkv/out projections.
@@ -72,7 +93,10 @@ _QKV_KERNEL_MARKERS = ("to_qkv", "to_q", "query")
 
 
 def resolve_peak_flops(
-    override: Optional[float] = None, devices=None
+    override: Optional[float] = None,
+    devices=None,
+    *,
+    dot_dtype: Optional[str] = None,
 ) -> tuple[Optional[float], str]:
     """Per-chip peak FLOP/s and where the number came from.
 
@@ -81,17 +105,28 @@ def resolve_peak_flops(
     (:data:`~sav_tpu.utils.flops.PEAK_FLOPS_PER_CHIP`) → the
     deterministic CPU fake → ``(None, 'unknown')`` for an accelerator the
     table does not know (MFU is then unreportable rather than wrong).
+
+    ``dot_dtype`` keys the peak by what the dots actually run in
+    (:data:`DOT_DTYPE_PEAK_FACTOR` — ``"int8"`` doubles the table's bf16
+    number, the MXU's 2:1 int8:bf16 ratio; the source string carries the
+    scaling so an int8-scaled peak is never mistaken for the table's).
+    An explicit ``override`` is taken verbatim — the operator stated the
+    peak for the arm they are measuring.
     """
     if override:
         return float(override), "override"
     import jax
 
+    factor = DOT_DTYPE_PEAK_FACTOR.get(
+        str(dot_dtype).lower() if dot_dtype is not None else "bf16", 1.0
+    )
+    tag = f":{str(dot_dtype).lower()}" if factor != 1.0 else ""
     devices = jax.devices() if devices is None else devices
     peak = per_chip_peak_flops(devices)
     if peak:
-        return peak, "device-table"
+        return peak * factor, "device-table" + tag
     if getattr(devices[0], "platform", None) == "cpu":
-        return CPU_FAKE_PEAK_FLOPS, "cpu-fake"
+        return CPU_FAKE_PEAK_FLOPS * factor, "cpu-fake" + tag
     return None, "unknown"
 
 

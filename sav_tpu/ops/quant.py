@@ -1,0 +1,346 @@
+"""Int8 quantized matmuls: AQT-style training dot + quantized-weights
+serving (ISSUE 17, ROADMAP item 1).
+
+The projection/FFN dots are ~31% of step time and already sit near the
+bf16 matmul roofline (PERF.md §2/§5), so the next integer-factor win is
+a narrower dtype. This module is the single source of int8 truth:
+
+- **Per-channel symmetric scales from the contracting dimension.**
+  ``quantize_channelwise(a, axes)`` computes ``s = amax(|a|, axes)/127``
+  per output channel and ``q = clip(round(a/s), ±127)`` — symmetric
+  (no zero-point), so the int8×int8 product needs no cross terms
+  (Jacob et al. 2018 §2.3 simplification for symmetric weights).
+- **int8×int8→int32 accumulation.** Every quantized contraction runs
+  ``jax.lax.dot_general(..., preferred_element_type=jnp.int32)`` — the
+  MXU's native int8 pipe — and dequantizes on exit by the scalar
+  product of the two per-channel scales.
+- **Straight-through estimator + stochastic rounding** (training arm).
+  :func:`int8_ste_dot` is a ``custom_vjp``: the forward runs the
+  quantized dot, the backward re-derives both gradient dots as int8
+  contractions with the *gradient* tensor quantized by stochastic
+  rounding (``floor(g/s + u)``, ``u ~ U[0,1)`` — unbiased, the AQT
+  recipe that keeps SGD's expected update intact; Abdolrashidi et al.
+  2021 §3.2). The rng rides the trainer's existing ``fold_in`` recipe
+  as a ``"quant"`` rng stream — no ad-hoc ``PRNGKey`` construction
+  anywhere (SAV110).
+- **``quantize_params``** converts a trained bf16/f32 param tree into
+  the int8+scales serving tree (kernels → int8 + per-channel ``scale``
+  leaf, everything else cast to the serving template's dtype). The
+  serving modules (mode ``"int8_serve"``) declare the int8 ``kernel``
+  under the *same tree path* as the float one, so SpecLayout sharding
+  rules and checkpoint naming carry over unchanged; the new ``scale``
+  leaf is tiny and replicates under the layout's default spec.
+
+Contraction convention (matches ``flax.linen.DenseGeneral``): ``x``
+contracts its **trailing** ``n`` axes against the **leading** ``n``
+axes of ``w`` — every projection/FFN dot in the model zoo fits this
+shape, which keeps both transposed gradient dots expressible as plain
+leading/trailing contractions (docs/quantization.md).
+
+Attention QK/AV stays bf16 by design: PERF §5 shows those dots are not
+matmul-roofline-bound, so int8 there buys noise, not time.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Callable, Optional, Sequence, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from flax import linen as nn
+
+# Symmetric int8: [-127, 127]. -128 is unused so the range is symmetric
+# and negation never overflows (the Jacob et al. restricted-range
+# convention).
+INT8_AMAX = 127.0
+
+
+def _f32(a):
+    return a.astype(jnp.float32)
+
+
+def quantize_channelwise(a, contract_axes: Sequence[int]):
+    """Symmetric per-channel int8 quantization.
+
+    ``contract_axes`` are the axes about to be contracted away: the
+    scale reduces over exactly those axes (keepdims), giving one scale
+    per *surviving* channel. Returns ``(q int8, scale f32)`` with
+    ``a ≈ q * scale``. All-zero channels get scale 1.0 (q is 0 there
+    anyway), so dequantization never divides by or multiplies with 0/0.
+    """
+    axes = tuple(int(ax) for ax in contract_axes)
+    a = _f32(a)
+    amax = jnp.max(jnp.abs(a), axis=axes, keepdims=True)
+    scale = jnp.where(amax > 0.0, amax / INT8_AMAX, 1.0)
+    q = jnp.clip(jnp.round(a / scale), -INT8_AMAX, INT8_AMAX).astype(jnp.int8)
+    return q, scale
+
+
+def quantize_stochastic(a, contract_axes: Sequence[int], key):
+    """:func:`quantize_channelwise` with stochastic rounding:
+    ``floor(a/s + u)``, ``u ~ U[0,1)`` — ``E[q*s] = a``, the unbiased
+    rounding the gradient tensor needs (round-to-nearest gradients bias
+    small updates toward zero; AQT §3.2)."""
+    axes = tuple(int(ax) for ax in contract_axes)
+    a = _f32(a)
+    amax = jnp.max(jnp.abs(a), axis=axes, keepdims=True)
+    scale = jnp.where(amax > 0.0, amax / INT8_AMAX, 1.0)
+    noise = jax.random.uniform(key, a.shape, jnp.float32)
+    q = jnp.clip(jnp.floor(a / scale + noise), -INT8_AMAX, INT8_AMAX)
+    return q.astype(jnp.int8), scale
+
+
+def _contract_dims(x_ndim: int, w_ndim: int, n: int):
+    """dot_general dims: trailing ``n`` axes of x vs leading ``n`` of w."""
+    del w_ndim
+    return (
+        (tuple(range(x_ndim - n, x_ndim)), tuple(range(n))),
+        ((), ()),
+    )
+
+
+def _int8_contract(qx, sx, qw, sw, dims, out_scale_shape_x, out_scale_shape_w):
+    """One int8×int8→int32 contraction + per-channel dequantize."""
+    acc = jax.lax.dot_general(
+        qx, qw, dims, preferred_element_type=jnp.int32
+    )
+    return (
+        _f32(acc)
+        * sx.reshape(out_scale_shape_x)
+        * sw.reshape(out_scale_shape_w)
+    )
+
+
+def as_key_data(key):
+    """Raw uint32 key data from either key flavor (typed or legacy) —
+    the custom_vjp carries raw bits so its residues stay plain arrays."""
+    if jnp.issubdtype(key.dtype, jax.dtypes.prng_key):
+        return jax.random.key_data(key)
+    return key
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3,))
+def int8_ste_dot(x, w, key, n_contract):
+    """Quantized dot with an STE backward (the QAT training dot).
+
+    ``x`` contracts its trailing ``n_contract`` axes against the
+    leading ``n_contract`` axes of ``w``; ``key`` is raw uint32 key
+    data (:func:`as_key_data`) consumed only by the backward's
+    stochastic rounding. Forward: per-channel int8 quantize both
+    operands, int32-accumulate, dequantize. Backward: both transposed
+    gradient dots run int8 too, with the incoming cotangent
+    stochastically rounded — weights/activations round-to-nearest.
+    """
+    out, _ = _ste_fwd(x, w, key, n_contract)
+    return out
+
+
+def _ste_fwd(x, w, key, n):
+    nb = x.ndim - n  # x free (batch-ish) axes
+    nf = w.ndim - n  # w free (feature) axes
+    qx, sx = quantize_channelwise(x, range(nb, x.ndim))
+    qw, sw = quantize_channelwise(w, range(n))
+    out = _int8_contract(
+        qx, sx, qw, sw,
+        _contract_dims(x.ndim, w.ndim, n),
+        x.shape[:nb] + (1,) * nf,
+        w.shape[n:],
+    ).astype(jnp.result_type(x, w))
+    return out, (x, w, key)
+
+
+def _ste_bwd(n, res, g):
+    x, w, key = res
+    nb = x.ndim - n
+    nf = w.ndim - n
+    k_dx, k_dw = jax.random.split(key)
+    # dx = g ·_F w  (contract the nf feature axes of both) — the
+    # cotangent is the noisy operand: stochastic rounding keeps it
+    # unbiased; the weight re-quantizes round-to-nearest per in-channel.
+    qg, sg = quantize_stochastic(g, range(nb, g.ndim), k_dx)
+    qwt, swt = quantize_channelwise(w, range(n, w.ndim))
+    dx = _int8_contract(
+        qg, sg, qwt, swt,
+        (
+            (tuple(range(nb, g.ndim)), tuple(range(n, w.ndim))),
+            ((), ()),
+        ),
+        g.shape[:nb] + (1,) * n,
+        w.shape[:n],
+    ).astype(x.dtype)
+    # dw = x ·_B g  (contract the nb batch axes of both).
+    qxt, sxt = quantize_channelwise(x, range(nb))
+    qg2, sg2 = quantize_stochastic(g, range(nb), k_dw)
+    dw = _int8_contract(
+        qxt, sxt, qg2, sg2,
+        ((tuple(range(nb)), tuple(range(nb))), ((), ())),
+        x.shape[nb:] + (1,) * nf,
+        g.shape[nb:],
+    ).astype(w.dtype)
+    # The key is integer data: its cotangent is the empty float0 zero.
+    dkey = np.zeros(np.shape(key), jax.dtypes.float0)
+    return dx, dw, dkey
+
+
+int8_ste_dot.defvjp(_ste_fwd, _ste_bwd)
+
+
+def int8_serve_dot(x, q_kernel, scale, n_contract: int):
+    """The serving-side dot: pre-quantized int8 weights + per-channel
+    ``scale`` (shape = the kernel's feature dims), activations
+    quantized dynamically per row. Returns f32 (caller casts + biases).
+    """
+    n = int(n_contract)
+    nb = x.ndim - n
+    nf = q_kernel.ndim - n
+    qx, sx = quantize_channelwise(x, range(nb, x.ndim))
+    return _int8_contract(
+        qx, sx, q_kernel, jnp.asarray(scale, jnp.float32),
+        _contract_dims(x.ndim, q_kernel.ndim, n),
+        x.shape[:nb] + (1,) * nf,
+        np.shape(scale),
+    )
+
+
+# --------------------------------------------------------------- modules
+
+
+def _canonical_tuple(v) -> tuple:
+    return tuple(v) if isinstance(v, (tuple, list)) else (v,)
+
+
+def quant_rng_data(module: nn.Module):
+    """The module-side half of the SAV110-clean rng recipe: the trainer
+    threads one ``"quant"`` stream per step (its existing ``fold_in``
+    ladder), ``make_rng`` folds in the module path so every quantized
+    dot rounds with independent bits. Outside training (init, eval,
+    serving) there is no stream and no backward — a zeros key keeps the
+    forward trace identical without minting an ad-hoc seed."""
+    if not module.is_initializing() and module.has_rng("quant"):
+        return as_key_data(module.make_rng("quant"))
+    return jnp.zeros((2,), jnp.uint32)
+
+
+class QuantDenseGeneral(nn.Module):
+    """Drop-in quantized twin of ``nn.DenseGeneral`` (and, with scalar
+    ``features``/``axis=-1``, of ``nn.Dense``).
+
+    mode="int8" (QAT): declares the *same* float ``kernel``/``bias``
+    params at the same tree paths and with the same init numerics as
+    the flax layer it replaces — a quant-arm checkpoint is
+    byte-compatible with the bf16 arm — but routes the contraction
+    through :func:`int8_ste_dot`.
+
+    mode="int8_serve": declares ``kernel`` as int8 (same path/shape —
+    SpecLayout rules keyed on the name still apply) plus a per-channel
+    f32 ``scale`` leaf shaped like the feature dims; the pair is
+    produced from a trained float tree by :func:`quantize_params`.
+
+    ``axis`` must name the trailing axes of the input (what every
+    call-site in the zoo does) — that restriction is what keeps both
+    STE gradient dots expressible as int8 contractions.
+    """
+
+    features: Union[int, Sequence[int]]
+    mode: str = "int8"
+    axis: Union[int, Sequence[int]] = -1
+    use_bias: bool = True
+    dtype: Optional[Any] = None
+    param_dtype: Any = jnp.float32
+    kernel_init: Callable = nn.initializers.lecun_normal()
+    bias_init: Callable = nn.initializers.zeros_init()
+
+    @nn.compact
+    def __call__(self, x):
+        features = _canonical_tuple(self.features)
+        axis = tuple(sorted(a % x.ndim for a in _canonical_tuple(self.axis)))
+        n = len(axis)
+        if axis != tuple(range(x.ndim - n, x.ndim)):
+            raise ValueError(
+                f"QuantDenseGeneral contracts trailing axes only; got "
+                f"axis={axis} for ndim={x.ndim}"
+            )
+        kshape = tuple(x.shape[a] for a in axis) + features
+        if self.mode == "int8_serve":
+            q_kernel = self.param(
+                "kernel", nn.initializers.zeros_init(), kshape, jnp.int8
+            )
+            scale = self.param(
+                "scale", nn.initializers.ones_init(), features, jnp.float32
+            )
+            y = int8_serve_dot(
+                _f32(x) if self.dtype is None else x.astype(self.dtype),
+                q_kernel, scale, n,
+            )
+        elif self.mode == "int8":
+            def kernel_init_wrap(rng, shape, dtype=self.param_dtype):
+                # flax DenseGeneral's init contract: draw at the
+                # flattened 2-D fan shape, then fold — identical bytes
+                # to the layer this replaces.
+                flat = (
+                    int(np.prod(shape[:n])), int(np.prod(shape[n:]))
+                )
+                return jnp.reshape(self.kernel_init(rng, flat, dtype), shape)
+
+            kernel = self.param(
+                "kernel", kernel_init_wrap, kshape, self.param_dtype
+            )
+            x, kernel = nn.dtypes.promote_dtype(x, kernel, dtype=self.dtype)
+            y = int8_ste_dot(x, kernel, quant_rng_data(self), n)
+        else:
+            raise ValueError(f"unknown quant mode {self.mode!r}")
+        if self.use_bias:
+            bias = self.param(
+                "bias", self.bias_init, features, self.param_dtype
+            )
+            y = y + bias.astype(y.dtype)
+        if self.dtype is not None:
+            y = y.astype(self.dtype)
+        return y
+
+
+class QuantDense(QuantDenseGeneral):
+    """``nn.Dense`` twin: scalar features, one contracted axis."""
+
+
+# ------------------------------------------------------ tree conversion
+
+
+def is_quantized_template(t) -> bool:
+    """True for a module dict declaring the int8 kernel/scale pair."""
+    return (
+        isinstance(t, dict)
+        and "kernel" in t
+        and "scale" in t
+        and getattr(t["kernel"], "dtype", None) == jnp.int8
+    )
+
+
+def quantize_params(params, template):
+    """Trained float param tree → int8+scales serving tree.
+
+    ``template`` is the abstract (``jax.eval_shape``) param tree of the
+    same model built in ``mode="int8_serve"`` — wherever it declares an
+    int8 ``kernel`` with a sibling ``scale``, the float kernel is
+    quantized per-channel over its leading contracting axes
+    (``kernel.ndim - scale.ndim`` of them); every other leaf is cast to
+    the template's dtype. Jit-friendly: close over ``template`` (it is
+    a ShapeDtypeStruct tree, not hashable as an argument).
+    """
+
+    def walk(p, t):
+        if isinstance(t, dict):
+            if is_quantized_template(t):
+                n = p["kernel"].ndim - t["scale"].ndim
+                q, s = quantize_channelwise(p["kernel"], range(n))
+                out = {"kernel": q, "scale": s.reshape(t["scale"].shape)}
+                for k, tv in t.items():
+                    if k not in ("kernel", "scale"):
+                        out[k] = walk(p[k], tv)
+                return out
+            return {k: walk(p[k], t[k]) for k in t}
+        return p if p.dtype == t.dtype else p.astype(t.dtype)
+
+    return walk(params, template)

@@ -93,6 +93,17 @@ class ServeConfig:
     # Training checkpoint to serve (params-only restore; opt_state is
     # never materialized). None = fresh init (benches, smoke tests).
     checkpoint_dir: Optional[str] = None
+    # Serve int8 quantized weights (docs/quantization.md): the float
+    # (checkpoint-format) param tree converts through
+    # sav_tpu.ops.quant.quantize_params into int8 kernels + per-channel
+    # f32 scales, and every projection/FFN/head dot runs the int8 MXU
+    # pipe (the attention core stays in compute_dtype). Param HBM is
+    # ~half the bf16 arm's (startup_report["quant"] proves it); logits
+    # track the bf16 arm within the pinned tolerance
+    # (tests/test_quant.py parity gates). Works with any float source —
+    # a --quant QAT checkpoint (matching train/serve numerics) or a
+    # plain bf16 one (post-training quantization).
+    quant_weights: bool = False
     # Declarative sharding layout (sav_tpu/parallel/layout.py): a
     # built-in name ('tpN' | '2dXxY' | ...) or a tools/mesh_tune.py
     # preset path. The engine then builds its mesh from the layout and
@@ -307,12 +318,18 @@ class ServeEngine:
         self.compute_dtype = (
             jnp.bfloat16 if config.compute_dtype == "bfloat16" else jnp.float32
         )
+        # The dtype stamp telemetry/heartbeats/status tools render: what
+        # the *weights* are served in (docs/quantization.md).
+        self.serve_dtype = (
+            "int8" if config.quant_weights
+            else ("bf16" if config.compute_dtype == "bfloat16" else "f32")
+        )
         t0 = time.perf_counter()
+        self._restore_model = None
         if model is None:
             from sav_tpu.models import create_model
 
-            model = create_model(
-                config.model_name,
+            model_kwargs = dict(
                 num_classes=config.num_classes,
                 dtype=self.compute_dtype,
                 backend=config.attention_backend,
@@ -323,10 +340,38 @@ class ServeEngine:
                 ),
                 **(config.model_overrides or {}),
             )
+            model = create_model(
+                config.model_name,
+                quant="int8_serve" if config.quant_weights else None,
+                **model_kwargs,
+            )
+            if config.quant_weights:
+                # The restore twin: the same architecture in float form.
+                # Its param tree is what training checkpoints (and
+                # passed-in trees) hold; the int8 serving tree is derived
+                # from it by quantize_params below.
+                self._restore_model = create_model(
+                    config.model_name, quant=None, **model_kwargs
+                )
+        elif config.quant_weights:
+            raise ValueError(
+                "quant_weights=True builds its own int8_serve/float model "
+                "pair from the registry; pass model=None (an externally "
+                "built int8_serve model can be served directly — its "
+                "params are already quantized, so quant_weights adds "
+                "nothing)"
+            )
         self.model = model
+        if self._restore_model is None:
+            self._restore_model = model
         self._params, self._batch_stats, params_source = self._load_params(
             params, batch_stats
         )
+        self._quant_report: Optional[dict] = None
+        if config.quant_weights:
+            self._params, self._quant_report = self._quantize_params_tree(
+                self._params
+            )
         self._infer = jax.jit(build_infer_fn(model, self.compute_dtype))
         # ---- AOT: one executable per bucket, warmed from the cache ----
         compile_t0 = time.perf_counter()
@@ -339,6 +384,41 @@ class ServeEngine:
             self._executables[bucket] = lowered.compile()
         compile_s = time.perf_counter() - compile_t0
         cache_after = _count_cache_entries(config.compilation_cache_dir)
+        # Per-bucket executable HBM estimate (ride-along fix: the report
+        # used to say nothing about how much device memory each rung
+        # costs, so a ladder that barely fit was invisible until the
+        # allocator said otherwise). XLA's own memory_analysis when the
+        # backend provides one; an analytic floor (params + wire input +
+        # f32 logits) otherwise — the source is recorded so a reader
+        # knows which number they are trusting.
+        self._param_bytes = sum(
+            int(leaf.size) * jnp.dtype(leaf.dtype).itemsize
+            for leaf in jax.tree.leaves((self._params, self._batch_stats))
+        )
+        bucket_hbm: dict = {}
+        hbm_source = "analytic"
+        s = config.image_size
+        for bucket in self.ladder.buckets:
+            est = None
+            try:
+                ma = self._executables[bucket].memory_analysis()
+                est = int(
+                    getattr(ma, "argument_size_in_bytes", 0)
+                    + getattr(ma, "output_size_in_bytes", 0)
+                    + getattr(ma, "temp_size_in_bytes", 0)
+                    + getattr(ma, "generated_code_size_in_bytes", 0)
+                )
+            except Exception:
+                est = None
+            if est:
+                hbm_source = "memory_analysis"
+            else:
+                est = (
+                    self._param_bytes
+                    + bucket * s * s * 3
+                    + bucket * config.num_classes * 4
+                )
+            bucket_hbm[str(bucket)] = est
         # Warmup: one execution per bucket seeds the batcher's per-bucket
         # step-time estimates (and faults in any lazy backend state).
         self._step_est: dict = {}
@@ -368,6 +448,10 @@ class ServeEngine:
             "layout": self.layout.name,
             "buckets": list(self.ladder.buckets),
             "params_source": params_source,
+            "dtype": self.serve_dtype,
+            "param_bytes": self._param_bytes,
+            "bucket_hbm_bytes": bucket_hbm,
+            "bucket_hbm_source": hbm_source,
             "startup_s": round(time.perf_counter() - t0, 3),
             "compile_s": round(compile_s, 3),
             "warmup_s": round(time.perf_counter() - warmup_t0, 3),
@@ -384,6 +468,10 @@ class ServeEngine:
                 if scratch is not None else None
             ),
         }
+        if self._quant_report is not None:
+            # The HBM-density proof: int8 serving bytes vs what the same
+            # tree would weigh in bf16 (docs/quantization.md).
+            self.startup_report["quant"] = self._quant_report
         self.manifest = manifest
         if self.manifest is None and config.log_dir:
             from sav_tpu.obs.manifest import RunManifest
@@ -403,6 +491,12 @@ class ServeEngine:
             # Same provenance note the trainer stamps: "which layout was
             # this serving" reads from notes.layout alone.
             self.manifest.note("layout", self.layout.describe(self.mesh))
+            if self._quant_report is not None:
+                # notes.quant: "which arm was this" reads from here alone
+                # (regression_sentinel keys int8 records off it).
+                self.manifest.note(
+                    "quant", dict(self._quant_report, weights="int8")
+                )
         # ---- telemetry: spans + live windows + heartbeats + SLO --------
         self._telemetry: Optional[ServeTelemetry] = None
         self._watermark = None
@@ -446,6 +540,7 @@ class ServeEngine:
 
             self._telemetry = ServeTelemetry(
                 config.log_dir,
+                dtype=self.serve_dtype,
                 trace_ring=config.trace_ring,
                 exemplar_max=config.slow_exemplars,
                 exemplar_sigma=config.slow_sigma,
@@ -481,7 +576,13 @@ class ServeEngine:
         """(params, batch_stats, source): passed-in, params-only
         checkpoint restore, or fresh init — placed by the layout's param
         specs (replicated under the default DP layout; TP/2D layouts
-        shard the serving weights over the mesh)."""
+        shard the serving weights over the mesh).
+
+        Always the FLOAT (checkpoint-format) tree, built against
+        ``self._restore_model`` — under ``quant_weights`` the caller
+        converts it to the int8 serving tree afterwards
+        (:meth:`_quantize_params_tree`), so every params source
+        (checkpoint / passed / fresh init) quantizes identically."""
         if params is not None:
             def place(tree):
                 if not tree:
@@ -518,7 +619,9 @@ class ServeEngine:
         def init_fn(rng):
             dummy = jnp.zeros((1, s, s, 3), self.compute_dtype)
             variables = dict(
-                self.model.init({"params": rng}, dummy, is_training=False)
+                self._restore_model.init(
+                    {"params": rng}, dummy, is_training=False
+                )
             )
             return {
                 "params": variables.pop("params"),
@@ -541,7 +644,11 @@ class ServeEngine:
 
         def init_fn(rng):
             dummy = jnp.zeros((1, s, s, 3), self.compute_dtype)
-            return dict(self.model.init({"params": rng}, dummy, is_training=False))
+            return dict(
+                self._restore_model.init(
+                    {"params": rng}, dummy, is_training=False
+                )
+            )
 
         shapes = jax.eval_shape(init_fn, rng)
         template = {
@@ -557,6 +664,44 @@ class ServeEngine:
             template,
             shardings,
         )
+
+    def _quantize_params_tree(self, float_params) -> tuple:
+        """Float tree → the int8+scales serving tree, jitted with the
+        layout's ``out_shardings`` so the int8 kernels materialize
+        sharded exactly like their float twins (same tree paths — the
+        SpecLayout rules key on names); the tiny ``scale`` leaves match
+        no rule and replicate. Returns ``(quantized, report)`` where the
+        report is the HBM-density proof: serving bytes vs the bf16
+        weight of the same float tree."""
+        from sav_tpu.ops.quant import quantize_params
+
+        s = self.config.image_size
+
+        def init_fn(rng):
+            dummy = jnp.zeros((1, s, s, 3), self.compute_dtype)
+            return dict(
+                self.model.init({"params": rng}, dummy, is_training=False)
+            )["params"]
+
+        template = jax.eval_shape(init_fn, jax.random.PRNGKey(0))
+        shardings = self._blayout.param_shardings(template)
+        quantized = jax.jit(
+            lambda p: quantize_params(p, template), out_shardings=shardings
+        )(float_params)
+        bf16_equiv = sum(
+            int(leaf.size) * 2 for leaf in jax.tree.leaves(float_params)
+        )
+        serving = sum(
+            int(leaf.size) * jnp.dtype(leaf.dtype).itemsize
+            for leaf in jax.tree.leaves(quantized)
+        )
+        report = {
+            "weights_dtype": "int8",
+            "param_bytes_serving": int(serving),
+            "param_bytes_bf16_equiv": int(bf16_equiv),
+            "param_bytes_ratio": round(serving / max(bf16_equiv, 1), 4),
+        }
+        return quantized, report
 
     def _abstract_batch(self, bucket: int) -> dict:
         s = self.config.image_size
@@ -853,6 +998,10 @@ class ServeEngine:
             tele_summary = self._telemetry.close(outcome)
         if self.manifest is not None:
             metrics = self.ledger.flat_metrics()
+            if self.config.quant_weights:
+                # Flat marker so run records are filterable by arm even
+                # when the notes were stripped (sentinel isolation).
+                metrics["serve/quant_weights"] = 1.0
             if self.startup_report.get("compiled_from_scratch") is not None:
                 metrics["serve/compiled_from_scratch"] = float(
                     self.startup_report["compiled_from_scratch"]

@@ -690,6 +690,7 @@ class ServeTelemetry:
         self,
         log_dir: Optional[str] = None,
         *,
+        dtype: Optional[str] = None,
         trace_ring: int = 256,
         exemplar_max: int = 8,
         exemplar_sigma: float = 4.0,
@@ -709,6 +710,11 @@ class ServeTelemetry:
         hbm_fn: Optional[Callable[[], Optional[dict]]] = None,
     ):
         self.log_dir = log_dir
+        # Weight-serving dtype stamp ("bf16" | "f32" | "int8" — ISSUE
+        # 17): rides every heartbeat so fleet_status/serve_status can
+        # tell a quantized replica from a bf16 one without reading its
+        # manifest. None = unstamped (pre-quant callers).
+        self.dtype = dtype
         self.clock = clock
         self._wall = wall_clock
         self._perf = perf
@@ -950,6 +956,8 @@ class ServeTelemetry:
             "slo": self.slo.state(now),
             "exemplars": len(self._exemplars),
         }
+        if self.dtype is not None:
+            record["dtype"] = self.dtype
         if self._queue_stats_fn is not None:
             try:
                 qs = self._queue_stats_fn() or {}
@@ -1158,6 +1166,7 @@ def aggregate_serve(
             "beats": len(beats),
             "first_unix": beats[0].get("t"),
             "last_unix": last.get("t"),
+            "dtype": last.get("dtype"),
             "up_s": last.get("up_s"),
             "requests": last.get("requests"),
             "shed": last.get("shed"),

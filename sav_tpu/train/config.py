@@ -45,6 +45,18 @@ class TrainConfig:
     # (create_model(..., logits_dtype=...)); ignored when Trainer is
     # handed an externally built model, which carries its own setting.
     attention_logits_dtype: Optional[str] = None
+    # int8 quantized projection/FFN dots (sav_tpu/ops/quant.py, ISSUE 17):
+    # "int8" = the AQT-style QAT training arm — per-channel symmetric
+    # scales, int8×int8→int32 accumulation, STE forward, stochastic-
+    # rounded int8 gradient dots (rng rides the trainer's fold_in ladder
+    # as a "quant" stream). The param tree is byte-identical to the
+    # float arm, so quant checkpoints convert to int8 serving trees via
+    # sav_tpu.ops.quant.quantize_params (ServeConfig.quant_weights).
+    # Attention QK/AV stays in compute_dtype (PERF §5: not matmul-
+    # roofline-bound). None = the plain float path. Threaded as a model
+    # attribute (create_model(..., quant=...)); an externally built
+    # model carries its own setting.
+    quant: Optional[str] = None
     # Extra kwargs for create_model (e.g. {'remat': True} to rematerialize
     # encoder blocks when activations are HBM-bound, or architecture
     # overrides like {'num_layers': 2} for smoke runs). Serialized with the

@@ -167,6 +167,12 @@ class Trainer:
                     "sequence_parallel (the pipelined stages run the dense "
                     "attention core); pick one"
                 )
+            if config.quant is not None:
+                raise ValueError(
+                    "pipeline_parallel does not compose with the int8 quant "
+                    "arm yet (the pipelined stage wrappers do not thread the "
+                    "'quant' field); drop --quant or --pp"
+                )
             from sav_tpu.models.pipelined import create_pipelined_model
 
             self.model = create_pipelined_model(
@@ -190,6 +196,9 @@ class Trainer:
                     dtype=self.compute_dtype,
                     backend=config.attention_backend,
                     logits_dtype=config.attention_logits_dtype,
+                    # int8 QAT arm: projection/FFN dots via
+                    # sav_tpu/ops/quant.py (attention core stays bf16).
+                    quant=config.quant,
                     # SP threads the trainer's mesh into every attention
                     # block (the blocks shard_map q/k/v over its 'seq' axis).
                     seq_parallel=config.sequence_parallel,
@@ -224,6 +233,15 @@ class Trainer:
                     f"externally built model has logits_dtype={have!r}; "
                     "pass create_model(..., logits_dtype=...) to match, or "
                     "leave the config field None"
+                )
+            if config.quant is not None and (
+                getattr(model, "quant", None) != config.quant
+            ):
+                raise ValueError(
+                    f"config.quant={config.quant!r} but the externally "
+                    "built model does not carry it; pass "
+                    "create_model(..., quant=...) to match, or leave the "
+                    "config field None"
                 )
             if config.sequence_parallel is not None and (
                 getattr(model, "seq_parallel", None) != config.sequence_parallel
@@ -611,10 +629,20 @@ class Trainer:
         label_probs = self._label_probs(batch)
         has_bn = bool(state.batch_stats)
 
-        def loss_fn(params, batch_stats, images, label_probs, dropout_rng, sd_rng):
+        def loss_fn(
+            params, batch_stats, images, label_probs, dropout_rng, sd_rng,
+            quant_rng=None,
+        ):
             variables = {"params": params}
             if has_bn:
                 variables["batch_stats"] = batch_stats
+            rngs = {"dropout": dropout_rng, "stochastic_depth": sd_rng}
+            if quant_rng is not None:
+                # int8 QAT: stochastic rounding of the backward gradient
+                # dots (sav_tpu/ops/quant.py); flax's make_rng folds the
+                # module path in, so every quantized dot draws independent
+                # rounding bits from this one stream.
+                rngs["quant"] = quant_rng
             # 'losses' collects auxiliary objectives modules sow (e.g. the
             # MoE load-balancing loss); empty for most models.
             mutable = ["batch_stats", "losses"] if has_bn else ["losses"]
@@ -622,7 +650,7 @@ class Trainer:
                 variables,
                 images,
                 is_training=True,
-                rngs={"dropout": dropout_rng, "stochastic_depth": sd_rng},
+                rngs=rngs,
                 mutable=mutable,
             )
             new_batch_stats = new_vars["batch_stats"] if has_bn else batch_stats
@@ -645,11 +673,19 @@ class Trainer:
         accum = self.config.grad_accum_steps
         if accum < 1:
             raise ValueError(f"grad_accum_steps must be >= 1, got {accum}")
+        # The quant stream only exists on the int8 arm, and splits 3-way
+        # instead of 2-way there — float runs keep their exact historical
+        # dropout/stochastic-depth streams (pinned tests depend on them).
+        quantized = self.config.quant is not None
         if accum == 1:
-            dropout_rng, sd_rng = jax.random.split(step_rng)
+            if quantized:
+                dropout_rng, sd_rng, quant_rng = jax.random.split(step_rng, 3)
+            else:
+                dropout_rng, sd_rng = jax.random.split(step_rng)
+                quant_rng = None
             (loss, (logits, new_batch_stats, aux_loss)), grads = grad_fn(
                 state.params, state.batch_stats, images, label_probs,
-                dropout_rng, sd_rng,
+                dropout_rng, sd_rng, quant_rng,
             )
         else:
             # Gradient accumulation: scan over micro-batches, averaging
@@ -668,9 +704,14 @@ class Trainer:
             def micro(carry, xs):
                 bs, gsum, lsum, asum, i = carry
                 im, lp = xs
-                dr, sr = jax.random.split(jax.random.fold_in(step_rng, i))
+                micro_rng = jax.random.fold_in(step_rng, i)
+                if quantized:
+                    dr, sr, qr = jax.random.split(micro_rng, 3)
+                else:
+                    dr, sr = jax.random.split(micro_rng)
+                    qr = None
                 (l, (lg, nbs, ax)), g = grad_fn(
-                    state.params, bs, im, lp, dr, sr
+                    state.params, bs, im, lp, dr, sr, qr
                 )
                 gsum = jax.tree.map(jnp.add, gsum, g)
                 return (nbs, gsum, lsum + l, asum + ax, i + 1), lg

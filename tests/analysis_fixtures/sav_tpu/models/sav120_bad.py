@@ -1,0 +1,11 @@
+"""Known-bad: unscaled int8 casts outside the quant module (SAV120)."""
+import jax.numpy as jnp
+import numpy as np
+
+
+def compress_activations(x):
+    q = x.astype(jnp.int8)  # line 7: bare cast, no scale
+    q2 = x.astype("int8")  # line 8: string-dtype cast
+    buf = np.asarray(x, np.int8)  # line 9: positional int8 ctor
+    arr = jnp.array(x, dtype=jnp.int8)  # line 10: dtype= kwarg ctor
+    return q, q2, buf, arr

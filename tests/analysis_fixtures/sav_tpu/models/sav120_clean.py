@@ -1,0 +1,11 @@
+"""Known-clean: int8 born with a scale, or non-int8 casts (SAV120)."""
+import jax.numpy as jnp
+
+from sav_tpu.ops.quant import quantize_channelwise
+
+
+def project(x, w):
+    x = x.astype(jnp.bfloat16)  # dtype cast, not int8
+    q, scale = quantize_channelwise(x, 1)  # int8 WITH per-channel scale
+    widths = jnp.asarray([8, 16], dtype=jnp.int32)  # int32, not int8
+    return q, scale, w, widths

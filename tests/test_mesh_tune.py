@@ -73,6 +73,52 @@ def test_check_feasible_divisibility(mesh_tune):
     )
 
 
+def test_predict_step_time_dot_dtype_axis(mesh_tune):
+    """ISSUE 17 (docs/quantization.md): --dot-dtype int8 prices the
+    quantized arm — the caller resolves a 2x peak (halving the compute
+    term, passed doubled here exactly as run() does) and int8
+    activations halve the TP collective-traffic term relative to the
+    bf16 default, so the int8 prediction must be strictly faster on a
+    TP layout."""
+    import types
+
+    from sav_tpu.parallel.layout import layout_from_mesh_axes
+
+    params = {
+        "to_qkv": {
+            "kernel": jax.ShapeDtypeStruct((64, 3, 4, 16), jax.numpy.float32)
+        },
+        "pos_embedding": {
+            "pos_embedding": jax.ShapeDtypeStruct(
+                (1, 65, 64), jax.numpy.float32
+            )
+        },
+    }
+    cost = types.SimpleNamespace(flops=1e12, num_tokens=65)
+    # Pure TP (data=1): no dp gradient AllReduce term, so ALL collective
+    # traffic is activation-sized and the dtype ratio is exact.
+    tp4 = layout_from_mesh_axes({"data": 1, "model": 4}, name="tp4")
+    kwargs = dict(
+        global_batch=32, grad_accum=1, num_layers=2,
+        ici_bytes_per_s=1e9,
+    )
+    bf16 = mesh_tune.predict_step_time(
+        tp4, cost, params, peak_flops=1e12, dot_dtype=None, **kwargs
+    )
+    int8 = mesh_tune.predict_step_time(
+        tp4, cost, params, peak_flops=2e12, dot_dtype="int8", **kwargs
+    )
+    assert int8["total_s"] < bf16["total_s"]
+    assert int8["compute_s"] == pytest.approx(bf16["compute_s"] / 2)
+    assert int8["comm_s"] == pytest.approx(bf16["comm_s"] / 2)
+    assert "tp_block_allreduce" in int8["comm_terms"]
+    # f32 doubles the activation bytes instead (collectives get slower).
+    f32 = mesh_tune.predict_step_time(
+        tp4, cost, params, peak_flops=1e12, dot_dtype="f32", **kwargs
+    )
+    assert f32["total_s"] > bf16["total_s"]
+
+
 # -------------------------------------------------------------------- e2e
 
 
@@ -98,6 +144,7 @@ def sweep(mesh_tune, tmp_path_factory):
         iters=2,
         rounds=2,
         peak_flops=None,
+        dot_dtype=None,
         ici_gbps=None,
         trace=str(tmp / "trace"),
         out=out,

@@ -63,6 +63,37 @@ def test_device_table_matches_on_kind():
     assert resolve_peak_flops(None, devices=[Unknown()]) == (None, "unknown")
 
 
+def test_dot_dtype_axis_scales_peak_and_tags_source():
+    """ISSUE 17: the int8 arm's roofline denominator is 2x the bf16
+    table entry (the MXU's native int8 path) and the source string is
+    tagged ':int8' so a doubled peak can never masquerade as the bf16
+    one. bf16/f32 are the identity (untagged); explicit overrides are
+    taken verbatim — the operator's number is never scaled."""
+    from sav_tpu.obs.costs import dot_dtype_bytes
+
+    class FakeDevice:
+        platform = "tpu"
+        device_kind = "TPU v5 lite"
+
+    devices = [FakeDevice()]
+    assert resolve_peak_flops(None, devices=devices, dot_dtype="int8") == (
+        2 * 197e12, "device-table:int8",
+    )
+    assert resolve_peak_flops(None, devices=devices, dot_dtype="bf16") == (
+        197e12, "device-table",
+    )
+    # CPU fake doubles too, still labeled fake (+ the dtype tag).
+    assert resolve_peak_flops(None, dot_dtype="int8") == (
+        2 * CPU_FAKE_PEAK_FLOPS, "cpu-fake:int8",
+    )
+    assert resolve_peak_flops(5e12, dot_dtype="int8") == (5e12, "override")
+    # The activation-traffic side of the axis.
+    assert dot_dtype_bytes("int8") == 1
+    assert dot_dtype_bytes("bf16") == 2
+    assert dot_dtype_bytes("f32") == 4
+    assert dot_dtype_bytes(None) == 2  # the historical bf16 default
+
+
 # ----------------------------------------------------------- analytic walk
 
 

@@ -142,6 +142,12 @@ BAD_EXPECTATIONS = {
         ("SAV119", 19),  # float(metrics[...]) in _observe_completion()
         ("SAV119", 23),  # metrics[...].item() in router_beat()
     ],
+    "sav_tpu/models/sav120_bad.py": [
+        ("SAV120", 7),  # x.astype(jnp.int8) — bare cast, no scale
+        ("SAV120", 8),  # x.astype("int8") — string-dtype spelling
+        ("SAV120", 9),  # np.asarray(x, np.int8) — positional dtype
+        ("SAV120", 10),  # jnp.array(x, dtype=jnp.int8) — kwarg dtype
+    ],
 }
 
 CLEAN_FIXTURES = [
@@ -164,6 +170,7 @@ CLEAN_FIXTURES = [
     "sav_tpu/parallel/sav117_clean.py",
     "sav118_clean.py",
     "sav119_clean.py",
+    "sav_tpu/models/sav120_clean.py",
 ]
 
 

@@ -137,6 +137,17 @@ def test_adhoc_partition_spec_suppressions_are_zero():
     assert [f for f in result.suppressed if f.rule == "SAV117"] == []
 
 
+def test_unscaled_int8_cast_suppressions_are_zero():
+    """SAV120 (unscaled-int8-cast): every int8 tensor in the model/op/
+    serve stack is born in sav_tpu/ops/quant.py next to its per-channel
+    scale — the rule carries ZERO suppressions over the whole linted
+    surface, so scale-less int8 can never creep in one pragma at a time
+    (docs/quantization.md)."""
+    result = lint_paths(SELF_PATHS, root=ROOT, baseline=DEFAULT_BASELINE)
+    assert [f for f in result.findings if f.rule == "SAV120"] == []
+    assert [f for f in result.suppressed if f.rule == "SAV120"] == []
+
+
 def test_library_exit_suppressions_are_the_two_contracts():
     """SAV114's sanctioned library exits stay exactly the documented
     pair (docs/elasticity.md exit-code table): the watchdog's os._exit

@@ -105,6 +105,33 @@ def test_json_report_is_machine_readable():
     assert regressed == {"throughput", "mfu"}
 
 
+def test_quant_history_scores_under_quant_names_and_stays_isolated():
+    """ISSUE 17: serve_bench --quant-weights lines carry quant="int8"
+    and score under the quant_* metric names — an int8-only history.
+    The float serve line planted at the head of both fixtures must
+    neither flag nor be flagged: the plain serve metrics are simply
+    unscorable there (one measurement), proving the histories never
+    mix."""
+    proc = _run_cli(os.path.join(FIXTURES, "quant_clean"))
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "ok      quant_p99_latency_ms" in proc.stdout
+    assert "ok      quant_serve_throughput" in proc.stdout
+    assert "REGRESS" not in proc.stdout
+    proc = _run_cli("--json", os.path.join(FIXTURES, "quant_regressed"))
+    assert proc.returncode == 1, proc.stdout + proc.stderr
+    payload = json.loads(proc.stdout)
+    flagged = {v["metric"] for v in payload["verdicts"] if v["regressed"]}
+    assert flagged == {
+        "quant_p99_latency_ms", "quant_serve_throughput",
+        "quant_slo_hit_frac",
+    }
+    # The bf16 metrics were never scored at all — the float record is
+    # lone history, not baseline, on both fixtures.
+    scored = {v["metric"] for v in payload["verdicts"]}
+    assert "p99_latency_ms" not in scored
+    assert "serve_throughput" not in scored
+
+
 # --------------------------------------------------------- detection math
 
 

@@ -172,8 +172,9 @@ def render(log_dir: str, summary: dict, out) -> None:
             v = replicas[proc]
             occ = v.get("occupancy")
             flame = "  <-- SLO BURNING" if v.get("burning") else ""
+            dtype = f" [{v['dtype']}]" if v.get("dtype") else ""
             print(
-                f"  replica {proc}: p99 {v.get('p99_ms')} ms, "
+                f"  replica {proc}{dtype}: p99 {v.get('p99_ms')} ms, "
                 f"{v.get('throughput_rps')} req/s, queue "
                 f"{v.get('queue_depth')}, inflight {v.get('inflight')}"
                 + (f", occupancy {occ:.0%}" if occ is not None else "")

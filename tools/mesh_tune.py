@@ -209,6 +209,7 @@ def predict_step_time(
     num_layers: int,
     peak_flops: Optional[float],
     ici_bytes_per_s: float,
+    dot_dtype: Optional[str] = None,
 ) -> dict:
     """Predicted optimizer-step seconds = compute + collective traffic.
 
@@ -231,9 +232,15 @@ def predict_step_time(
     for leaf in jax.tree.leaves(abstract_params):
         param_bytes += float(np.prod(leaf.shape)) * np.dtype(leaf.dtype).itemsize
 
+    from sav_tpu.obs.costs import dot_dtype_bytes
+
     embed = _embed_dim(abstract_params) or 0
     tokens = cost.num_tokens
-    act_bytes = micro * tokens * embed * 2.0  # bf16 activations
+    # Activations ring at the dot dtype's width (obs/costs.py dtype
+    # axis, ISSUE 17): 2 B/elt for the bf16 default, 1 under --dot-dtype
+    # int8 — the int8 arm halves the TP collective volume along with
+    # doubling the peak, which is exactly why it re-ranks layouts.
+    act_bytes = micro * tokens * embed * float(dot_dtype_bytes(dot_dtype, 2))
 
     def ring(n: int) -> float:
         return 2.0 * (n - 1) / n if n > 1 else 0.0
@@ -530,7 +537,9 @@ def run(args, log=print) -> dict:
         or getattr(model, "num_layers", None)
         or 12
     )
-    peak_flops, peak_source = resolve_peak_flops(args.peak_flops, devices)
+    peak_flops, peak_source = resolve_peak_flops(
+        args.peak_flops, devices, dot_dtype=args.dot_dtype
+    )
     ici, ici_source = resolve_ici_bytes_per_s(args.ici_gbps and args.ici_gbps * 1e9)
     arms = [a.strip() for a in args.arms.split(",") if a.strip()]
     bad = set(arms) - set(ARMS)
@@ -572,6 +581,7 @@ def run(args, log=print) -> dict:
                     global_batch=args.global_batch, grad_accum=accum,
                     num_layers=num_layers, peak_flops=peak_flops,
                     ici_bytes_per_s=ici,
+                    dot_dtype=args.dot_dtype,
                 ),
             )
             candidates.append(cand)
@@ -624,6 +634,7 @@ def run(args, log=print) -> dict:
         "global_batch": args.global_batch,
         "peak_flops": peak_flops,
         "peak_source": peak_source,
+        "dot_dtype": args.dot_dtype,
         "ici_bytes_per_s": ici,
         "ici_source": ici_source,
         "candidates": [
@@ -714,6 +725,14 @@ def main(argv=None) -> int:
     p.add_argument("--rounds", type=int, default=3,
                    help="round-robin windows per candidate (minima reported)")
     p.add_argument("--peak-flops", type=float, default=None)
+    p.add_argument(
+        "--dot-dtype", default=None, choices=["bf16", "f32", "int8"],
+        help="dtype the projection/FFN dots run in (obs/costs.py dtype "
+        "axis): 'int8' ranks layouts for the quantized arm — 2x the "
+        "bf16 peak FLOP/s and half the activation bytes in the TP "
+        "collective terms (docs/quantization.md). Default: the bf16 "
+        "accounting, unchanged.",
+    )
     p.add_argument(
         "--ici-gbps", type=float, default=None,
         help="ICI bandwidth override, GB/s per chip (default: "

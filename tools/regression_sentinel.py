@@ -28,10 +28,15 @@ chains, higher — supervised runs only, docs/elasticity.md),
 lower), ``serve_throughput`` (serving req/s, higher),
 ``slo_hit_frac`` (deadline-hit fraction from the r11 serve telemetry's
 SLO tracker, higher — all present only on serving records,
-docs/serving.md), and ``fleet_p99_latency_ms`` /
+docs/serving.md), ``fleet_p99_latency_ms`` /
 ``fleet_throughput`` (the r15 replica-fleet router's end-to-end tail
 latency, lower, and fleet req/s, higher — present only on
-``serve_bench --replicas`` records). Infra failures
+``serve_bench --replicas`` records), and ``quant_p99_latency_ms`` /
+``quant_serve_throughput`` / ``quant_slo_hit_frac`` (the int8
+quantized-weights serving arm, ``serve_bench --quant-weights`` —
+present only on records stamped ``quant: "int8"``, an int8-only
+history isolated from the bf16 baseline; docs/quantization.md). Infra
+failures
 are *reported but never scored* — a down relay is
 not a regression (the BENCH_r05 lesson), and a history whose only deltas
 are infra failures exits clean.
@@ -126,6 +131,27 @@ METRICS = {
     # throughput means the ROUTER became the bottleneck (bad balancing,
     # over-shedding). Same presence contract as fleet_p99_latency_ms.
     "fleet_throughput": (True, 0.0),
+    # Quantized-weights serving tail latency (serve_bench
+    # --quant-weights — int8 weights with per-channel scales,
+    # docs/quantization.md): lower is better. A SEPARATE metric from
+    # p99_latency_ms on purpose, the fleet_* precedent: int8 and bf16
+    # runs execute different programs with different HBM traffic, so
+    # they are different baselines — a quant line sneaking into the
+    # float history (or vice versa) would poison both. Present only on
+    # records stamped ``quant: "int8"`` (lines) /
+    # ``serve/quant_weights`` (manifests); float serving records and
+    # everything else are skipped, not zero-filled. Absolute floor
+    # 1 ms, the p99_latency_ms rationale.
+    "quant_p99_latency_ms": (False, 1.0),
+    # Quantized-weights request throughput (req/s). Higher is better —
+    # a drop with a flat float baseline means the INT8 path regressed
+    # (dequant epilogue, scale layout), not serving in general. Same
+    # presence contract as quant_p99_latency_ms.
+    "quant_serve_throughput": (True, 0.0),
+    # Quantized-weights SLO hit fraction. Higher is better; one point
+    # of hit rate floor, the slo_hit_frac rationale. Same presence
+    # contract as quant_p99_latency_ms.
+    "quant_slo_hit_frac": (True, 0.01),
     # Router tracing overhead per completed request (ms — the router's
     # self-accounted trace/stamp/window cost, ISSUE 16; the fleet twin
     # of the engine's serve_overhead accounting). Lower is better — a

@@ -21,6 +21,12 @@ A/B arms:
                      drain ceiling
   --rate R           Poisson-free fixed schedule at R req/s: measures
                      latency under a target load
+  --quant-weights    int8 serving weights (per-channel scales,
+                     docs/quantization.md): the HBM-density arm — the
+                     line carries ``quant: "int8"`` and the sentinel
+                     scores it under ``quant_p99_latency_ms`` /
+                     ``quant_serve_throughput``, an int8-only history
+                     that never contaminates the bf16 baseline
 
 Fleet mode (``--replicas N`` — docs/serving.md "Fleet"): spins up N
 supervised engine replicas (tools/serve_fleet.py under the PR-9
@@ -87,6 +93,7 @@ def run(args, manifest) -> dict:
         max_queue=args.max_queue,
         deadline_ms=args.deadline_ms,
         checkpoint_dir=args.checkpoint,
+        quant_weights=args.quant_weights,
         layout_preset=args.layout_preset,
         compilation_cache_dir=args.compilation_cache_dir,
         # Telemetry artifacts (serve heartbeats, slow-request exemplars,
@@ -514,6 +521,16 @@ def main(argv=None) -> int:
         help="training checkpoint dir to serve (params-only restore — "
         "opt_state is never materialized)",
     )
+    parser.add_argument(
+        "--quant-weights", action="store_true",
+        help="serve int8 weights (per-channel scales, "
+        "sav_tpu/ops/quant.py): the float params are quantized at load "
+        "and every projection/FFN dot runs int8×int8→int32 — the HBM-"
+        "density A/B arm (docs/quantization.md). The line carries "
+        "quant='int8' and the sentinel scores it under the quant_* "
+        "metric names, so the int8 history never contaminates the "
+        "bf16 baseline",
+    )
     parser.add_argument("--compilation-cache-dir", default=None)
     parser.add_argument("--attn-tune-cache", default=None)
     parser.add_argument(
@@ -597,6 +614,13 @@ def main(argv=None) -> int:
         "directory expansion globs manifest*.json)",
     )
     args = parser.parse_args(argv)
+    if args.quant_weights and args.replicas:
+        # The fleet replicas are their own processes with their own
+        # engine configs (tools/serve_fleet.py) — wiring the quant arm
+        # through the pool is future work, and silently serving bf16
+        # under a quant-labelled line would poison the quant_* baseline.
+        parser.error("--quant-weights is a single-engine A/B arm; it "
+                     "does not compose with --replicas yet")
     if args.manifest is None:
         stamp = f"{time.strftime('%Y%m%d-%H%M%S')}-{os.getpid()}"
         args.manifest = (
@@ -681,11 +705,12 @@ def main(argv=None) -> int:
         args.buckets or f"pow2<={args.max_batch}"
     )
     load_desc = f"{args.rate} req/s" if args.rate > 0 else "flood"
+    weights_desc = ", int8 weights" if args.quant_weights else ""
     out = {
         "metric": (
             f"{args.model} serve p99 ms (buckets {ladder_desc}, "
             f"{load_desc}, deadline {args.deadline_ms} ms, "
-            f"{args.requests} reqs)"
+            f"{args.requests} reqs{weights_desc})"
         ),
         "unit": "ms",
         "outcome": "ok",
@@ -704,6 +729,12 @@ def main(argv=None) -> int:
         "startup": result["startup"],
         "manifest": manifest.path,
     }
+    if args.quant_weights:
+        # The quant stamp routes this line to the sentinel's quant_*
+        # metric names (sav_tpu/obs/manifest.py _bench_line_metrics) —
+        # int8 and bf16 latencies are different baselines and must
+        # never share a history. Older (float) lines lack the key.
+        out["quant"] = "int8"
     slo = result.get("slo") or {}
     if isinstance(slo.get("hit_frac"), (int, float)):
         out["slo_hit_frac"] = slo["hit_frac"]

@@ -93,8 +93,9 @@ def render(log_dir: str, summary: dict, out) -> None:
         v = replicas[proc]
         p99 = v.get("p99_ms")
         occ = v.get("occupancy")
+        dtype = f" [{v['dtype']}]" if v.get("dtype") else ""
         print(
-            f"replica {proc}: {v.get('beats', 0)} heartbeats, up "
+            f"replica {proc}{dtype}: {v.get('beats', 0)} heartbeats, up "
             f"{v.get('up_s')}s, last at {_fmt_unix(v.get('last_unix'))} — "
             f"{v.get('requests')} served, {v.get('shed')} shed",
             file=out,

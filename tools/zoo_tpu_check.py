@@ -14,10 +14,14 @@ serving engine buckets) for ONE representative per model family at the
 smallest bucket, proving all seven families are servable. ``--smoke``
 shrinks the configs (reduced depth, 64px inputs) so the serve arm runs
 in tier-1 on CPU (tests/test_serve.py); without it the full-size check
-needs the chip.
+needs the chip. ``--serve --quant-weights`` compiles + runs the int8
+quantized-weights serving program instead (float init →
+``quantize_params`` → AOT; docs/quantization.md) — the proof that all
+seven families are servable with int8 weights.
 
 Run: python tools/zoo_tpu_check.py            (~a few minutes; TPU)
      python tools/zoo_tpu_check.py --serve    (serving arm)
+     python tools/zoo_tpu_check.py --serve --quant-weights  (int8 arm)
 """
 
 from __future__ import annotations
@@ -64,9 +68,20 @@ SERVE_CASES = [
 ]
 
 
-def serve_check(name: str, kwargs: dict, image_size: int, batch: int):
+def serve_check(
+    name: str, kwargs: dict, image_size: int, batch: int,
+    quant_weights: bool = False,
+):
     """AOT-lower + compile + run the serving program for one family at
-    one bucket; returns (loss-free) (finite, compile+run seconds)."""
+    one bucket; returns (loss-free) (finite, compile+run seconds).
+
+    With ``quant_weights`` the check mirrors the engine's int8 arm
+    (docs/quantization.md): init a FLOAT tree, quantize it against the
+    int8_serve model's template (``quantize_params`` — per-channel
+    scales next to int8 kernels), and AOT-compile THAT program — the
+    proof that every family's quantized serving program builds and runs
+    finite on the target backend.
+    """
     import jax
     import jax.numpy as jnp
     import numpy as np
@@ -74,14 +89,30 @@ def serve_check(name: str, kwargs: dict, image_size: int, batch: int):
     from sav_tpu.models import create_model
     from sav_tpu.serve.engine import build_infer_fn
 
-    model = create_model(name, num_classes=10, dtype=jnp.bfloat16, **kwargs)
+    model = create_model(
+        name, num_classes=10, dtype=jnp.bfloat16,
+        quant="int8_serve" if quant_weights else None, **kwargs
+    )
+    float_model = (
+        create_model(name, num_classes=10, dtype=jnp.bfloat16, **kwargs)
+        if quant_weights else model
+    )
     rngs = {"params": jax.random.PRNGKey(0)}
     x0 = jnp.zeros((batch, image_size, image_size, 3), jnp.bfloat16)
     variables = dict(
-        jax.jit(lambda r, xx: model.init(r, xx, is_training=False))(rngs, x0)
+        jax.jit(lambda r, xx: float_model.init(r, xx, is_training=False))(
+            rngs, x0
+        )
     )
     params = variables.pop("params")
     batch_stats = variables.pop("batch_stats", {})
+    if quant_weights:
+        from sav_tpu.ops.quant import quantize_params
+
+        template = jax.eval_shape(
+            lambda r, xx: model.init(r, xx, is_training=False), rngs, x0
+        )["params"]
+        params = jax.jit(lambda p: quantize_params(p, template))(params)
     infer = build_infer_fn(model, jnp.bfloat16)
     abstract = {
         "images": jax.ShapeDtypeStruct(
@@ -174,26 +205,38 @@ def main():
         help="with --serve: shrink configs (2-ish layers, 64px) so the "
         "sweep runs in tier-1 on CPU",
     )
+    p.add_argument(
+        "--quant-weights", action="store_true",
+        help="with --serve: compile + run the int8 quantized-weights "
+        "serving program (float init -> quantize_params -> AOT) for "
+        "every family — the docs/quantization.md servability proof",
+    )
     args = p.parse_args()
+    if args.quant_weights and not args.serve:
+        p.error("--quant-weights is a serving arm; pass --serve too")
 
     if args.serve:
         image_size = 64 if args.smoke else 224
+        arm = "serve:int8" if args.quant_weights else "serve"
         failures = 0
         for name, smoke_overrides in SERVE_CASES:
             if args.only and args.only not in name:
                 continue
             kwargs = smoke_overrides if args.smoke else {}
             try:
-                finite, dt = serve_check(name, kwargs, image_size, batch=1)
+                finite, dt = serve_check(
+                    name, kwargs, image_size, batch=1,
+                    quant_weights=args.quant_weights,
+                )
                 status = "OK " if finite else "NONFINITE"
                 print(
-                    f"{status} serve {name:20s} aot-compile+run {dt:.1f}s",
+                    f"{status} {arm} {name:20s} aot-compile+run {dt:.1f}s",
                     flush=True,
                 )
                 failures += 0 if finite else 1
             except Exception:
                 failures += 1
-                print(f"FAIL serve {name:20s}", flush=True)
+                print(f"FAIL {arm} {name:20s}", flush=True)
                 traceback.print_exc()
         print(f"\n{'ALL SERVABLE' if failures == 0 else f'{failures} FAILURES'}")
         raise SystemExit(1 if failures else 0)

@@ -74,6 +74,15 @@ import click
     "'float32' forces f32 softmax under bf16 compute.",
 )
 @click.option(
+    "--quant", type=click.Choice(["int8"]), default=None,
+    help="int8 quantized matmuls (AQT-style QAT, sav_tpu/ops/quant.py): "
+    "every projection/FFN/head dot runs int8xint8->int32 with per-channel "
+    "symmetric scales, STE forward, stochastic-rounded gradient dots; the "
+    "attention QK/AV core stays in the compute dtype. The param tree is "
+    "identical to the float arm, so checkpoints convert to int8 serving "
+    "trees (serve --quant-weights; docs/quantization.md).",
+)
+@click.option(
     "--remat/--no-remat", default=False,
     help="Rematerialize encoder blocks in the backward pass "
     "(jax.checkpoint): trades ~1/3 more forward FLOPs for O(layers) "
@@ -476,7 +485,7 @@ def _run(
     num_epochs, warmup_epochs, learning_rate, weight_decay, label_smoothing,
     ema_decay, clip_grad, grad_accum, augmentation, patch_size, backend,
     attn_tune_cache, logits_dtype,
-    remat, dtype, layout_preset, tp, fsdp, sp, sp_method, pp,
+    quant, remat, dtype, layout_preset, tp, fsdp, sp, sp_method, pp,
     pp_microbatches, preset,
     checkpoint_dir, checkpoint_every_steps, checkpoint_every_secs,
     supervise, max_restarts, restart_backoff, skip_steps, synth_data,
@@ -627,6 +636,7 @@ def _run(
         attention_logits_dtype=(
             None if logits_dtype == "inherit" else logits_dtype
         ),
+        quant=quant,
         model_overrides={"remat": True} if remat else None,
         global_batch_size=batch_size,
         augment=augmentation,
@@ -698,6 +708,7 @@ def _run(
             "async_feed": "async_feed", "feed_depth": "feed_depth",
             "compilation_cache_dir": "compilation_cache_dir",
             "attn_tune_cache": "attention_tune_cache",
+            "quant": "quant",
             "peak_flops": "peak_flops",
             "log_dir": "log_dir", "diagnostics": "diagnostics",
             "trace_spans": "trace_spans", "watchdog_secs": "watchdog_secs",

@@ -1,0 +1,1004 @@
+"""Whole-program concurrency analysis: locksets, lock order, liveness.
+
+The serving arc made sav_tpu genuinely concurrent — feeder, batcher,
+engine device loop, router dispatch workers, replica supervisors,
+heartbeat writers, watchdog, autoprof, recorder all share state across
+stdlib threads — and every concurrency bug shipped so far (the batcher
+and router submit/close TOCTOU strandings, ``Router.admit``'s two-lock
+max_inflight overshoot, the HeartbeatWriter deadlock on a hung FS) was
+caught by hand in review. This module is the static half of the fix:
+the first :class:`~sav_tpu.analysis.lint.ProjectRule` pass, seeing all
+linted modules at once, in the classic pairing of lockset analysis
+(Eraser — Savage et al. 1997) and acquisition-order cycle detection
+(GoodLock — Havelund 2000):
+
+- **SAV121 unguarded-shared-state** — per class, every
+  ``threading.Lock/RLock/Condition`` attribute is inventoried and the
+  *guarded set* inferred (attributes accessed under ``with self._lock``
+  in any method). A guarded attribute read or written WITHOUT the lock
+  in a method reachable from a ``Thread`` target or registered callback
+  is the Eraser lockset violation. Methods whose every intra-class call
+  site holds the lock (the ``_window_snapshot`` "owner must hold the
+  lock" idiom) inherit that guard, so the flow-insensitive pass does
+  not flag lock-held helpers.
+- **SAV122 lock-order-cycle** — every nested ``with``-acquisition (and
+  every call made WHILE holding a lock into a method/function that
+  acquires more, across classes and files via ``self.attr``-type
+  inference) contributes a directed edge to ONE repo-wide graph; any
+  cycle is a deadlock-in-waiting and an error. ``tools/lockgraph.py``
+  renders this graph; :mod:`sav_tpu.analysis.lockwatch` checks the
+  *observed* graph against it at runtime.
+- **SAV123 unbounded-blocking-call** — a zero-argument ``acquire()`` /
+  ``join()`` / ``get()`` / ``wait()`` (or an explicit ``timeout=None``)
+  in the modules bound by the watchdog exit-4 and heartbeat
+  bounded-lock contracts (``serve/``, ``obs/``, ``data/``, ``train/``).
+  The zero-argument spellings are exactly the block-forever forms
+  (``dict.get`` needs a key, ``str.join`` an iterable — no false
+  positives from those), and the contracts require every block to be
+  bounded: the HeartbeatWriter's ``acquire(timeout=...)`` discipline
+  and the watchdog's bounded dumper joins are the in-repo exemplars.
+- **SAV124 thread-leak** — a ``threading.Thread(...)`` started with
+  ``daemon`` unset and never ``join``ed (by its bound name) anywhere in
+  the module: on interpreter exit a non-daemon thread blocks process
+  teardown forever — the quiet cousin of the hang the watchdog exists
+  to abort.
+
+Known limits, by design (heuristics, not proofs — the savlint charter):
+bounded ``lock.acquire(timeout=...)`` guards are not credited to the
+guarded set (the HeartbeatWriter deliberately drops rather than blocks,
+so its attributes are protected by that discipline, not by ``with``);
+``threading.Thread`` *subclasses* are not traced to their constructor
+kwargs (SAV124) though a ``run()`` method on one IS a thread target
+(SAV121); and attribute types resolve by bare class name across the
+linted set. The pragma system covers the residue, with justifications.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator, Optional
+
+from sav_tpu.analysis.lint import Finding, ModuleInfo, ProjectRule
+
+LOCK_FACTORIES = {
+    "threading.Lock": "Lock",
+    "threading.RLock": "RLock",
+    "threading.Condition": "Condition",
+    "threading.Semaphore": "Semaphore",
+    "threading.BoundedSemaphore": "Semaphore",
+}
+
+# Primitives that synchronize internally: reading/calling them without a
+# lock is their entire point, so they never enter the guarded set.
+SELF_SYNCHRONIZED_FACTORIES = frozenset(
+    {
+        "threading.Event",
+        "queue.Queue",
+        "queue.SimpleQueue",
+        "queue.LifoQueue",
+        "queue.PriorityQueue",
+        "collections.deque",
+    }
+)
+
+# Modules bound by a bounded-blocking contract: the watchdog's exit-4
+# guarantee (docs/elasticity.md) presumes no thread blocks forever, and
+# the heartbeat writers promise drop-never-block (docs/fleet.md).
+BOUNDED_CONTRACT_PATHS = (
+    "sav_tpu/serve/",
+    "sav_tpu/obs/",
+    "sav_tpu/data/",
+    "sav_tpu/train/",
+)
+
+_BLOCKING_VERBS = frozenset({"acquire", "join", "get", "wait"})
+
+# Method names that mutate their receiver in place: calling one on a
+# self-attribute IS a write to that attribute for lockset purposes
+# (``self._window.clear()`` races ``self._window.append()`` exactly as
+# an assignment would), even though the AST context is a Load.
+_MUTATOR_METHODS = frozenset(
+    {
+        "append", "appendleft", "extend", "insert", "remove", "clear",
+        "pop", "popitem", "popleft", "add", "discard", "update",
+        "setdefault", "sort", "reverse",
+    }
+)
+
+
+def _module_dotted(module: ModuleInfo) -> str:
+    rel = module.relpath
+    if rel.endswith(".py"):
+        rel = rel[:-3]
+    return rel.replace("/", ".")
+
+
+def _is_self_attr(node) -> bool:
+    return (
+        isinstance(node, ast.Attribute)
+        and isinstance(node.value, ast.Name)
+        and node.value.id == "self"
+    )
+
+
+def _site(module: ModuleInfo, node) -> dict:
+    return {
+        "path": module.relpath,
+        "line": getattr(node, "lineno", 1),
+        "code": module.function_source_line(getattr(node, "lineno", 1)),
+    }
+
+
+# ------------------------------------------------------------- inventory
+
+
+class _ClassFacts:
+    """Everything the four rules need to know about one class."""
+
+    def __init__(self, module: ModuleInfo, node: ast.ClassDef):
+        self.module = module
+        self.node = node
+        self.name = node.name
+        self.methods = {
+            n.name: n
+            for n in node.body
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
+        }
+        self.lock_attrs: dict[str, dict] = {}  # attr -> {kind, line}
+        self.sync_attrs: set = set()
+        self.attr_types: dict[str, str] = {}  # attr -> bare class name
+        self.thread_targets: set = set()
+        self.callback_refs: set = set()
+        # filled by _analyze_method, keyed by method name:
+        self.accesses: dict[str, list] = {}
+        self.acquires: dict[str, list] = {}
+        self.calls: dict[str, list] = {}
+        self.call_sites: dict[str, list] = {}  # callee -> [held-set, ...]
+
+    def lock_id(self, attr: str) -> str:
+        return f"{self.name}.{attr}"
+
+
+class _ModuleFacts:
+    def __init__(self, module: ModuleInfo):
+        self.module = module
+        self.dotted = _module_dotted(module)
+        self.classes: dict[str, _ClassFacts] = {}
+        self.global_locks: dict[str, dict] = {}  # bare name -> {id, kind}
+        # module-level function name -> FunctionDef (for cross-module
+        # acquire closures, e.g. attn_tuning.lookup's ``with _lock:``)
+        self.functions: dict[str, ast.FunctionDef] = {}
+        self.fn_acquires: dict[str, list] = {}
+        self.fn_calls: dict[str, list] = {}
+
+
+def _inventory_class(module: ModuleInfo, cls: ast.ClassDef) -> _ClassFacts:
+    facts = _ClassFacts(module, cls)
+    for base in cls.bases:
+        resolved = module.resolve(base)
+        bare = base.attr if isinstance(base, ast.Attribute) else getattr(
+            base, "id", None
+        )
+        if resolved == "threading.Thread" or bare == "Thread":
+            facts.thread_targets.add("run")
+    for node in ast.walk(cls):
+        if isinstance(node, ast.Call):
+            resolved = module.resolve_call(node)
+            if resolved == "threading.Thread":
+                for k in node.keywords:
+                    if k.arg == "target" and _is_self_attr(k.value):
+                        facts.thread_targets.add(k.value.attr)
+            for arg in list(node.args) + [k.value for k in node.keywords]:
+                if _is_self_attr(arg):
+                    facts.callback_refs.add(arg.attr)
+        if isinstance(node, ast.Assign) and isinstance(node.value, ast.Call):
+            resolved = module.resolve_call(node.value)
+            for t in node.targets:
+                if not _is_self_attr(t):
+                    continue
+                if resolved in LOCK_FACTORIES:
+                    facts.lock_attrs[t.attr] = {
+                        "kind": LOCK_FACTORIES[resolved],
+                        "line": node.lineno,
+                    }
+                elif resolved in SELF_SYNCHRONIZED_FACTORIES:
+                    facts.sync_attrs.add(t.attr)
+                else:
+                    # ``self._ring = SpanRing(...)`` — remember the bare
+                    # constructor name so a call on the attribute can be
+                    # resolved to that class's lock acquisitions.
+                    fn = node.value.func
+                    bare = (
+                        fn.attr if isinstance(fn, ast.Attribute)
+                        else getattr(fn, "id", None)
+                    )
+                    if bare and bare[:1].isupper():
+                        facts.attr_types[t.attr] = bare
+    facts.callback_refs &= set(facts.methods)
+    return facts
+
+
+def _inventory_module(module: ModuleInfo) -> _ModuleFacts:
+    mf = _ModuleFacts(module)
+    for stmt in module.tree.body:
+        if isinstance(stmt, ast.Assign) and isinstance(stmt.value, ast.Call):
+            resolved = module.resolve_call(stmt.value)
+            if resolved in LOCK_FACTORIES:
+                for t in stmt.targets:
+                    if isinstance(t, ast.Name):
+                        mf.global_locks[t.id] = {
+                            "id": f"{mf.dotted}.{t.id}",
+                            "kind": LOCK_FACTORIES[resolved],
+                            "line": stmt.lineno,
+                        }
+        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            mf.functions[stmt.name] = stmt
+    for cls in module.classes:
+        facts = _inventory_class(module, cls)
+        mf.classes[facts.name] = facts
+    return mf
+
+
+# ------------------------------------------------- per-function analysis
+
+
+def _analyze_body(
+    mf: _ModuleFacts,
+    facts: Optional[_ClassFacts],
+    fn,
+):
+    """(accesses, acquires, calls) for one function body.
+
+    Tracks the lexically-held lock set through ``with`` statements —
+    the SAV107 protection-tracking visitor, extended with acquisition
+    ORDER (``held_before`` per acquire, the GoodLock edge source) and a
+    call ledger (who is invoked while which locks are held).
+    """
+    module = mf.module
+    accesses: list = []
+    acquires: list = []
+    calls: list = []
+
+    def lock_of(expr) -> Optional[str]:
+        if (
+            facts is not None
+            and _is_self_attr(expr)
+            and expr.attr in facts.lock_attrs
+        ):
+            return facts.lock_id(expr.attr)
+        if isinstance(expr, ast.Name) and expr.id in mf.global_locks:
+            return mf.global_locks[expr.id]["id"]
+        return None
+
+    def visit(node, held):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)):
+            return  # closures run in their own thread context (SAV107)
+        if isinstance(node, ast.With):
+            inner = list(held)
+            for item in node.items:
+                lid = lock_of(item.context_expr)
+                if lid is not None:
+                    acquires.append((lid, item.context_expr, tuple(inner)))
+                    inner.append(lid)
+                else:
+                    visit(item.context_expr, held)
+            for child in node.body:
+                visit(child, inner)
+            return
+        if (
+            facts is not None
+            and _is_self_attr(node)
+            and node.attr not in facts.methods
+        ):
+            is_write = isinstance(node.ctx, (ast.Store, ast.Del))
+            accesses.append((node.attr, node, is_write, frozenset(held)))
+            return
+        if (
+            facts is not None
+            and isinstance(node, ast.Subscript)
+            and isinstance(node.ctx, (ast.Store, ast.Del))
+            and _is_self_attr(node.value)
+        ):
+            # self.x[k] = v / del self.x[k]: a WRITE to x's contents.
+            accesses.append(
+                (node.value.attr, node.value, True, frozenset(held))
+            )
+        if isinstance(node, ast.Call):
+            f = node.func
+            if facts is not None and _is_self_attr(f):
+                calls.append(("self", f.attr, node, frozenset(held)))
+            elif (
+                facts is not None
+                and isinstance(f, ast.Attribute)
+                and _is_self_attr(f.value)
+            ):
+                calls.append(
+                    ("attr", (f.value.attr, f.attr), node, frozenset(held))
+                )
+                if f.attr in _MUTATOR_METHODS:
+                    # self.x.append(...) writes x as surely as = does.
+                    accesses.append(
+                        (f.value.attr, f.value, True, frozenset(held))
+                    )
+            else:
+                resolved = module.resolve_call(node)
+                if resolved is not None:
+                    calls.append(("global", resolved, node, frozenset(held)))
+                elif isinstance(f, ast.Name) and f.id in mf.functions:
+                    calls.append(
+                        ("global", f"{mf.dotted}.{f.id}", node,
+                         frozenset(held))
+                    )
+        for child in ast.iter_child_nodes(node):
+            visit(child, held)
+
+    for stmt in fn.body:
+        visit(stmt, [])
+    return accesses, acquires, calls
+
+
+def _analyze(modules: list) -> dict:
+    """The shared whole-program pass, memoized per lint run."""
+    mfs = [_inventory_module(m) for m in modules]
+    classes_by_name: dict[str, _ClassFacts] = {}
+    for mf in mfs:
+        for name, facts in mf.classes.items():
+            classes_by_name.setdefault(name, facts)
+    for mf in mfs:
+        for facts in mf.classes.values():
+            for mname, method in facts.methods.items():
+                acc, acq, cal = _analyze_body(mf, facts, method)
+                facts.accesses[mname] = acc
+                facts.acquires[mname] = acq
+                facts.calls[mname] = cal
+                for kind, name, _node, held in cal:
+                    if kind == "self":
+                        facts.call_sites.setdefault(name, []).append(held)
+        for fname, fn in mf.functions.items():
+            _acc, acq, cal = _analyze_body(mf, None, fn)
+            mf.fn_acquires[fname] = acq
+            mf.fn_calls[fname] = cal
+    return {"mfs": mfs, "classes": classes_by_name}
+
+
+_CACHE: dict = {"modules": None, "value": None}
+
+
+def _analysis_for(modules: list) -> dict:
+    """Memoize on the identity of the module list: the four rules run
+    back-to-back over the same ``lint_paths`` parse, and the whole-
+    program pass must not run four times (the wall-time budget). The
+    cache holds strong references, so identity comparison is sound —
+    a cached module's id cannot be recycled while it is cached."""
+    cached = _CACHE["modules"]
+    if (
+        cached is None
+        or len(cached) != len(modules)
+        or any(a is not b for a, b in zip(cached, modules))
+    ):
+        _CACHE["modules"] = list(modules)
+        _CACHE["value"] = _analyze(modules)
+    return _CACHE["value"]
+
+
+# --------------------------------------------------- acquire closures
+
+
+def _acquire_closure(analysis: dict) -> dict:
+    """callable-key -> set of lock ids it may acquire (transitively).
+
+    Keys: ``("m", ClassName, method)`` and ``("f", module.dotted, fn)``.
+    Cross-class edges resolve ``self.attr.method()`` through the
+    inventory's attr types; cross-module function calls resolve through
+    each file's import aliases to the defining module's dotted name.
+    """
+    classes = analysis["classes"]
+    fns: dict[str, tuple] = {}
+    for mf in analysis["mfs"]:
+        for fname in mf.functions:
+            fns[f"{mf.dotted}.{fname}"] = (mf, fname)
+
+    memo: dict = {}
+
+    def closure(key, stack) -> set:
+        if key in memo:
+            return memo[key]
+        if key in stack:
+            return set()  # recursion: the partial set is enough
+        stack = stack | {key}
+        out: set = set()
+        if key[0] == "m":
+            facts = classes.get(key[1])
+            if facts is None or key[2] not in facts.methods:
+                return set()
+            acquires = facts.acquires.get(key[2], [])
+            calls = facts.calls.get(key[2], [])
+        else:
+            mf, fname = fns.get(f"{key[1]}.{key[2]}", (None, None))
+            if mf is None:
+                return set()
+            acquires = mf.fn_acquires.get(fname, [])
+            calls = mf.fn_calls.get(fname, [])
+        for lid, _node, _held in acquires:
+            out.add(lid)
+        for kind, name, _node, _held in calls:
+            for sub in _resolve_callee(analysis, key, kind, name):
+                out |= closure(sub, stack)
+        memo[key] = out
+        return out
+
+    keys = [("m", c, m) for c, f in classes.items() for m in f.methods]
+    keys += [("f", mf.dotted, fname) for mf, fname in fns.values()]
+    for key in keys:
+        closure(key, frozenset())
+    return memo
+
+
+def _resolve_callee(analysis, caller_key, kind, name) -> list:
+    """Callable keys a recorded call might land on (possibly empty)."""
+    classes = analysis["classes"]
+    if kind == "self":
+        return [("m", caller_key[1], name)]
+    if kind == "attr":
+        attr, meth = name
+        owner = classes.get(caller_key[1])
+        if owner is None:
+            return []
+        cls_name = owner.attr_types.get(attr)
+        if cls_name and cls_name in classes:
+            return [("m", cls_name, meth)]
+        return []
+    # kind == "global": dotted name -> module function (never a class —
+    # constructing an object acquires nothing in this repo's idiom)
+    if "." in name:
+        mod, fname = name.rsplit(".", 1)
+        return [("f", mod, fname)]
+    return []
+
+
+# ------------------------------------------------------ the lock graph
+
+
+def build_lock_graph(modules: list) -> dict:
+    """The repo-wide static acquisition-order graph.
+
+    Nodes are lock identities (``Class.attr`` / ``module.GLOBAL``);
+    a directed edge A→B means somewhere, B is acquired while A is held —
+    either lexically nested ``with`` blocks or a call made under A into
+    code whose transitive acquire set contains B. Returned shape is
+    JSON-ready for tools/lockgraph.py.
+    """
+    analysis = _analysis_for(modules)
+    closures = _acquire_closure(analysis)
+    nodes: dict[str, dict] = {}
+    edges: dict[tuple, dict] = {}
+
+    def note_edge(src, dst, module, node, via):
+        if src == dst:
+            kind = nodes.get(src, {}).get("kind")
+            if kind == "RLock":
+                return  # re-entry is an RLock's contract, not a cycle
+        e = edges.setdefault(
+            (src, dst), {"src": src, "dst": dst, "sites": []}
+        )
+        if len(e["sites"]) < 8:
+            site = _site(module, node)
+            if via:
+                site["via"] = via
+            e["sites"].append(site)
+
+    for mf in analysis["mfs"]:
+        for name, info in mf.global_locks.items():
+            nodes[info["id"]] = {
+                "id": info["id"],
+                "kind": info["kind"],
+                "path": mf.module.relpath,
+                "line": info["line"],
+            }
+        for facts in mf.classes.values():
+            for attr, info in facts.lock_attrs.items():
+                lid = facts.lock_id(attr)
+                nodes[lid] = {
+                    "id": lid,
+                    "kind": info["kind"],
+                    "path": mf.module.relpath,
+                    "line": info["line"],
+                }
+    for mf in analysis["mfs"]:
+        for facts in mf.classes.values():
+            for mname in facts.methods:
+                for lid, node, held in facts.acquires.get(mname, []):
+                    for h in held:
+                        note_edge(h, lid, mf.module, node, None)
+                for kind, name, node, held in facts.calls.get(mname, []):
+                    if not held:
+                        continue
+                    for key in _resolve_callee(
+                        analysis, ("m", facts.name, mname), kind, name
+                    ):
+                        for lid in closures.get(key, set()):
+                            for h in held:
+                                note_edge(
+                                    h, lid, mf.module, node,
+                                    f"{key[1]}.{key[2]}",
+                                )
+        for fname in mf.functions:
+            for lid, node, held in mf.fn_acquires.get(fname, []):
+                for h in held:
+                    note_edge(h, lid, mf.module, node, None)
+            for kind, name, node, held in mf.fn_calls.get(fname, []):
+                if not held:
+                    continue
+                for key in _resolve_callee(
+                    analysis, ("f", mf.dotted, fname), kind, name
+                ):
+                    for lid in closures.get(key, set()):
+                        for h in held:
+                            note_edge(
+                                h, lid, mf.module, node,
+                                f"{key[1]}.{key[2]}",
+                            )
+    for src, dst in edges:
+        for lid in (src, dst):
+            nodes.setdefault(
+                lid, {"id": lid, "kind": "Lock", "path": "", "line": 0}
+            )
+    return {
+        "nodes": [nodes[k] for k in sorted(nodes)],
+        "edges": [edges[k] for k in sorted(edges)],
+    }
+
+
+def find_cycles(edges: list) -> list:
+    """Elementary cycles in the acquisition graph (each as a node list,
+    ``[A, B, A]``). Tarjan SCCs first, then one representative cycle
+    per non-trivial SCC plus every self-edge — enough for an error
+    message a human can act on, without path explosion."""
+    adj: dict[str, list] = {}
+    for e in edges:
+        adj.setdefault(e["src"], []).append(e["dst"])
+        adj.setdefault(e["dst"], [])
+    index: dict[str, int] = {}
+    low: dict[str, int] = {}
+    onstack: set = set()
+    stack: list = []
+    sccs: list = []
+    counter = [0]
+
+    def strongconnect(v):
+        # iterative Tarjan: (node, iterator) frames
+        work = [(v, iter(adj[v]))]
+        index[v] = low[v] = counter[0]
+        counter[0] += 1
+        stack.append(v)
+        onstack.add(v)
+        while work:
+            node, it = work[-1]
+            advanced = False
+            for w in it:
+                if w not in index:
+                    index[w] = low[w] = counter[0]
+                    counter[0] += 1
+                    stack.append(w)
+                    onstack.add(w)
+                    work.append((w, iter(adj[w])))
+                    advanced = True
+                    break
+                if w in onstack:
+                    low[node] = min(low[node], index[w])
+            if advanced:
+                continue
+            work.pop()
+            if work:
+                parent = work[-1][0]
+                low[parent] = min(low[parent], low[node])
+            if low[node] == index[node]:
+                scc = []
+                while True:
+                    w = stack.pop()
+                    onstack.discard(w)
+                    scc.append(w)
+                    if w == node:
+                        break
+                sccs.append(scc)
+
+    for v in sorted(adj):
+        if v not in index:
+            strongconnect(v)
+
+    cycles = []
+    edge_set = {(e["src"], e["dst"]) for e in edges}
+    for scc in sccs:
+        if len(scc) == 1:
+            v = scc[0]
+            if (v, v) in edge_set:
+                cycles.append([v, v])
+            continue
+        # One representative cycle: walk within the SCC from its
+        # smallest node until it closes.
+        members = set(scc)
+        start = min(scc)
+        path = [start]
+        seen = {start}
+        node = start
+        while True:
+            nxt = min(
+                (w for w in adj[node] if w in members), default=None
+            )
+            if nxt is None:
+                break
+            path.append(nxt)
+            if nxt == start:
+                cycles.append(path)
+                break
+            if nxt in seen:
+                cycles.append(path[path.index(nxt):])
+                break
+            seen.add(nxt)
+            node = nxt
+    return cycles
+
+
+# ---------------------------------------------------------------- SAV121
+
+
+class UnguardedSharedState(ProjectRule):
+    """A lock-guarded attribute touched without its lock on a thread path.
+
+    The Eraser lockset discipline: if ANY method accesses ``self.x``
+    under ``with self._lock``, then ``x`` is shared mutable state and
+    every access from code another thread can execute (a ``Thread``
+    target, a registered callback, or anything they call) must hold
+    that lock too. A lockless read is a torn snapshot; a lockless write
+    is a lost update — the ``Router._last_refresh`` check-then-act race
+    (two dispatch workers both deciding to refresh) was exactly this
+    shape. ``__init__`` runs before the thread exists and is exempt;
+    ``Event``/``Queue``/``deque`` attributes synchronize internally and
+    are exempt; underscore methods whose every intra-class call site
+    holds the lock inherit the guard (the documented "caller must hold
+    the lock" helpers).
+    """
+
+    id = "SAV121"
+    name = "unguarded-shared-state"
+    severity = "error"
+    hint = (
+        "take the guarding lock (with self._lock: ...) around this "
+        "access, or move it into an existing critical section"
+    )
+
+    def check_project(self, modules: list) -> Iterator[Finding]:
+        analysis = _analysis_for(modules)
+        for mf in analysis["mfs"]:
+            for facts in mf.classes.values():
+                yield from self._check_class(mf, facts)
+
+    def _check_class(self, mf, facts) -> Iterator[Finding]:
+        if not facts.lock_attrs:
+            return
+        entries = facts.thread_targets | facts.callback_refs
+        entries &= set(facts.methods)
+        entries.discard("__init__")
+        if not entries:
+            return
+        # Guarded set: attr -> the locks it has been seen held under.
+        # Attributes never WRITTEN outside __init__ are immutable-after-
+        # init (Eraser's read-shared state: clocks, config, callables
+        # wired at construction) — reading one inside a critical section
+        # does not make it shared mutable state, so they never enter the
+        # guarded set at all.
+        guards: dict[str, set] = {}
+        mutable: set = set()
+        for mname, accs in facts.accesses.items():
+            for attr, _node, is_write, _held in accs:
+                if is_write and mname != "__init__":
+                    mutable.add(attr)
+        for mname, accs in facts.accesses.items():
+            for attr, _node, _w, held in accs:
+                if held and attr in mutable and attr not in facts.lock_attrs:
+                    guards.setdefault(attr, set()).update(held)
+        if not guards:
+            return
+        # Inherited guard: private helpers invoked ONLY under the lock.
+        inherited: dict[str, frozenset] = {}
+        for mname in facts.methods:
+            sites = facts.call_sites.get(mname, [])
+            if (
+                mname.startswith("_")
+                and not mname.startswith("__")
+                and mname not in entries
+                and sites
+            ):
+                common = frozenset.intersection(*map(frozenset, sites))
+                if common:
+                    inherited[mname] = common
+        # Reachability: thread targets/callbacks plus everything they
+        # call on self — the code another thread can be inside.
+        reachable = set()
+        frontier = list(entries)
+        while frontier:
+            mname = frontier.pop()
+            if mname in reachable or mname not in facts.methods:
+                continue
+            reachable.add(mname)
+            for kind, name, _node, _held in facts.calls.get(mname, []):
+                if kind == "self" and name not in reachable:
+                    frontier.append(name)
+        reachable.discard("__init__")
+        seen: set = set()
+        for mname in sorted(reachable):
+            base = inherited.get(mname, frozenset())
+            for attr, node, is_write, held in facts.accesses.get(mname, []):
+                if attr in facts.sync_attrs or attr in facts.lock_attrs:
+                    continue
+                locks = guards.get(attr)
+                if not locks:
+                    continue
+                if (held | base) & locks:
+                    continue
+                key = (mname, attr, node.lineno)
+                if key in seen:
+                    continue
+                seen.add(key)
+                lock_names = ", ".join(sorted(locks))
+                verb = "written" if is_write else "read"
+                yield Finding(
+                    rule=self.id,
+                    severity=self.severity,
+                    path=mf.module.relpath,
+                    line=node.lineno,
+                    col=node.col_offset,
+                    message=(
+                        f"self.{attr} is guarded by {lock_names} elsewhere "
+                        f"but {verb} lock-free here, in {facts.name}."
+                        f"{mname}() — reachable from thread entry point(s) "
+                        f"{sorted(entries & (reachable | entries))[:3]}"
+                    ),
+                    hint=self.hint,
+                    code="",
+                    end_line=getattr(node, "end_lineno", 0) or node.lineno,
+                )
+
+
+# ---------------------------------------------------------------- SAV122
+
+
+class LockOrderCycle(ProjectRule):
+    """A cycle in the repo-wide lock acquisition-order graph.
+
+    GoodLock's insight: you do not need to OBSERVE the deadlock, only
+    the inconsistent order. Thread 1 holding A while taking B and
+    thread 2 holding B while taking A deadlock the first time the
+    schedule interleaves them — possibly months in, under load, on the
+    serve fleet. Every nested acquisition in the linted set (including
+    ones reached through calls made while holding a lock, across files)
+    is an edge; a cycle is an error naming the full loop and every
+    contributing site. The finding anchors at the cycle's first edge;
+    the fix is to rank the locks (docs/concurrency.md's hierarchy) and
+    release before calling down. A self-edge on a plain ``Lock`` (a
+    method re-entering its own critical section via a call) is the
+    one-lock special case and just as fatal; ``RLock`` re-entry is
+    exempt.
+    """
+
+    id = "SAV122"
+    name = "lock-order-cycle"
+    severity = "error"
+    hint = (
+        "impose one acquisition order (docs/concurrency.md) — release "
+        "the outer lock before calling into code that takes the other, "
+        "or merge the two critical sections under one lock"
+    )
+
+    def check_project(self, modules: list) -> Iterator[Finding]:
+        graph = build_lock_graph(modules)
+        cycles = find_cycles(graph["edges"])
+        if not cycles:
+            return
+        edges = {(e["src"], e["dst"]): e for e in graph["edges"]}
+        for cycle in cycles:
+            pairs = list(zip(cycle, cycle[1:]))
+            sites = []
+            for pair in pairs:
+                e = edges.get(pair)
+                if e and e["sites"]:
+                    sites.append((pair, e["sites"][0]))
+            if not sites:
+                continue
+            sites.sort(key=lambda s: (s[1]["path"], s[1]["line"]))
+            (src, dst), anchor = sites[0]
+            loop = " -> ".join(cycle)
+            others = "; ".join(
+                f"{a} -> {b} at {s['path']}:{s['line']}"
+                for (a, b), s in sites[1:]
+            )
+            yield Finding(
+                rule=self.id,
+                severity=self.severity,
+                path=anchor["path"],
+                line=anchor["line"],
+                col=0,
+                message=(
+                    f"lock-order cycle {loop}: this site acquires {dst} "
+                    f"while holding {src}"
+                    + (f"; closing edge(s): {others}" if others else "")
+                ),
+                hint=self.hint,
+                code=anchor.get("code", ""),
+                end_line=anchor["line"],
+            )
+
+
+# ---------------------------------------------------------------- SAV123
+
+
+class UnboundedBlockingCall(ProjectRule):
+    """A block-forever call in a module that promised it never blocks.
+
+    ``serve/``, ``obs/``, ``data/`` and ``train/`` operate under two
+    explicit liveness contracts: the watchdog guarantees exit-4 within
+    its deadline even when the main thread is wedged (every dump/join
+    on that path is bounded, docs/elasticity.md), and the heartbeat
+    writers drop-never-block (``acquire(timeout=LOCK_TIMEOUT_S)``,
+    docs/fleet.md). A bare ``acquire()`` / ``join()`` / ``get()`` /
+    ``wait()`` — the zero-argument spellings ARE the unbounded forms;
+    ``dict.get``/``str.join`` always take arguments, so this does not
+    misfire on them — re-introduces exactly the unbounded wait those
+    contracts exist to exclude: the ``Router._worker`` queue get was
+    the live example (a worker blocked forever if ``close()`` died
+    before posting its sentinel).
+    """
+
+    id = "SAV123"
+    name = "unbounded-blocking-call"
+    severity = "error"
+    hint = (
+        "pass a timeout (and handle expiry) — e.g. get(timeout=POLL_S) "
+        "re-checking the stop flag, join(timeout=...), "
+        "acquire(timeout=...) with a drop/degrade path"
+    )
+
+    def check_project(self, modules: list) -> Iterator[Finding]:
+        for module in modules:
+            if not module.relpath.startswith(BOUNDED_CONTRACT_PATHS):
+                continue
+            for node in module.nodes:
+                if not (
+                    isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)
+                    and node.func.attr in _BLOCKING_VERBS
+                ):
+                    continue
+                unbounded = not node.args and not node.keywords
+                if not unbounded:
+                    unbounded = any(
+                        k.arg == "timeout"
+                        and isinstance(k.value, ast.Constant)
+                        and k.value.value is None
+                        for k in node.keywords
+                    )
+                if not unbounded:
+                    continue
+                yield Finding(
+                    rule=self.id,
+                    severity=self.severity,
+                    path=module.relpath,
+                    line=node.lineno,
+                    col=node.col_offset,
+                    message=(
+                        f"unbounded .{node.func.attr}() in a module bound "
+                        "by the watchdog exit-4 / heartbeat bounded-"
+                        "blocking contracts — this call can block forever"
+                    ),
+                    hint=self.hint,
+                    code="",
+                    end_line=getattr(node, "end_lineno", 0) or node.lineno,
+                )
+
+
+# ---------------------------------------------------------------- SAV124
+
+
+class ThreadLeak(ProjectRule):
+    """A started thread nothing will ever reap.
+
+    A ``threading.Thread`` with ``daemon`` unset is non-daemon: process
+    exit blocks until it returns, so a worker looping on a queue keeps
+    the interpreter alive forever — the silent cousin of the hang the
+    watchdog aborts, except the watchdog has already exited. Every
+    thread must either be a daemon (and then its OWNER must bound any
+    join on it — SAV123) or be joined on all exit paths. The rule
+    checks the binding: a construction with ``daemon=True``, a
+    ``<name>.daemon = True`` assignment, or a ``<name>.join(...)``
+    anywhere in the module clears it. (``Thread`` subclasses that set
+    ``daemon`` in ``__init__`` are out of scope — their *instantiation*
+    does not resolve to ``threading.Thread``.)
+    """
+
+    id = "SAV124"
+    name = "thread-leak"
+    severity = "warning"
+    hint = (
+        "pass daemon=True (plus a bounded join/close for orderly "
+        "shutdown), or join the thread with a timeout on every exit "
+        "path"
+    )
+
+    def check_project(self, modules: list) -> Iterator[Finding]:
+        for module in modules:
+            yield from self._check_module(module)
+
+    def _check_module(self, module: ModuleInfo) -> Iterator[Finding]:
+        thread_calls: list = []
+        bound: dict[int, Optional[str]] = {}
+        joined: set = set()
+        daemoned: set = set()
+        from sav_tpu.analysis.lint import _bare_name
+
+        for node in module.nodes:
+            if (
+                isinstance(node, ast.Call)
+                and module.resolve_call(node) == "threading.Thread"
+            ):
+                thread_calls.append(node)
+            if isinstance(node, ast.Assign):
+                if isinstance(node.value, ast.Call) and (
+                    module.resolve_call(node.value) == "threading.Thread"
+                ):
+                    for t in node.targets:
+                        bound[id(node.value)] = _bare_name(t)
+                for t in node.targets:
+                    if (
+                        isinstance(t, ast.Attribute)
+                        and t.attr == "daemon"
+                        and isinstance(node.value, ast.Constant)
+                        and node.value.value is True
+                    ):
+                        name = _bare_name(t.value)
+                        if name:
+                            daemoned.add(name)
+            if (
+                isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr == "join"
+            ):
+                name = _bare_name(node.func.value)
+                if name:
+                    joined.add(name)
+        for call in thread_calls:
+            daemon_kw = next(
+                (k for k in call.keywords if k.arg == "daemon"), None
+            )
+            if (
+                daemon_kw is not None
+                and isinstance(daemon_kw.value, ast.Constant)
+                and daemon_kw.value.value is True
+            ):
+                continue
+            name = bound.get(id(call))
+            if name and (name in joined or name in daemoned):
+                continue
+            where = f"bound to {name!r}" if name else "unbound"
+            yield Finding(
+                rule=self.id,
+                severity=self.severity,
+                path=module.relpath,
+                line=call.lineno,
+                col=call.col_offset,
+                message=(
+                    f"Thread created with daemon unset and never joined "
+                    f"({where} in this module) — a leaked non-daemon "
+                    "thread blocks interpreter exit forever"
+                ),
+                hint=self.hint,
+                code="",
+                end_line=getattr(call, "end_lineno", 0) or call.lineno,
+            )
+
+
+CONCURRENCY_RULES = [
+    UnguardedSharedState(),
+    LockOrderCycle(),
+    UnboundedBlockingCall(),
+    ThreadLeak(),
+]

@@ -141,10 +141,16 @@ class ModuleInfo:
         self.lines = source.splitlines()
         self.tree = ast.parse(source, filename=path)
         self.pragmas = parse_pragmas(source)
+        # ONE walk, shared by every rule: ``module.nodes`` replaces the
+        # per-rule ``ast.walk(module.tree)`` re-walks (21 rules × N nodes
+        # became 1 × N + 21 cheap list iterations — the wall-time budget
+        # in test_savlint_self.py holds the line).
+        self.nodes: list = list(ast.walk(self.tree))
+        self.classes = [n for n in self.nodes if isinstance(n, ast.ClassDef)]
         self._aliases = self._collect_aliases()
         self.functions = [
             n
-            for n in ast.walk(self.tree)
+            for n in self.nodes
             if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
         ]
         self.jitted_names, self.jitted_defs = self._collect_jitted()
@@ -153,7 +159,7 @@ class ModuleInfo:
 
     def _collect_aliases(self) -> dict:
         aliases: dict[str, str] = {}
-        for node in ast.walk(self.tree):
+        for node in self.nodes:
             if isinstance(node, ast.Import):
                 for a in node.names:
                     aliases[a.asname or a.name.split(".")[0]] = (
@@ -192,7 +198,7 @@ class ModuleInfo:
         call expressions.
         """
         names: set[str] = set()
-        for node in ast.walk(self.tree):
+        for node in self.nodes:
             if isinstance(node, ast.Call) and self.resolve_call(node) == "jax.jit":
                 if node.args:
                     target = node.args[0]
@@ -237,6 +243,31 @@ def _bare_name(node) -> Optional[str]:
     if isinstance(node, ast.Name):
         return node.id
     return None
+
+
+# ------------------------------------------------------------ project rules
+
+
+class ProjectRule:
+    """A rule that sees EVERY linted module at once (whole-program).
+
+    Per-file rules (:class:`~sav_tpu.analysis.rules.Rule`) are blind to
+    anything outside their module — fine for host-sync and dtype
+    hygiene, structurally insufficient for concurrency: a lock-order
+    cycle is two files each locally innocent. ``check_project`` receives
+    the full list of parsed :class:`ModuleInfo` objects; findings carry
+    ``path`` set to the owning module's relpath so pragma/baseline
+    suppression applies exactly as for per-file findings. Subclasses
+    live in :mod:`sav_tpu.analysis.concurrency`.
+    """
+
+    id: str = ""
+    name: str = ""
+    severity: str = "error"
+    hint: str = ""
+
+    def check_project(self, modules: list) -> Iterator[Finding]:
+        raise NotImplementedError
 
 
 # ---------------------------------------------------------------- baseline
@@ -342,50 +373,89 @@ def iter_python_files(paths: Iterable[str]) -> Iterator[str]:
             yield p
 
 
-def lint_file(
-    path: str,
-    root: Optional[str] = None,
-    rules: Optional[list] = None,
-) -> list[Finding]:
-    """All findings for one file, pragma suppression already marked."""
-    from sav_tpu.analysis.rules import ALL_RULES, check_pragma_hygiene
-
-    rules = ALL_RULES if rules is None else rules
-    root = root if root is not None else os.getcwd()
+def _load_module(path: str, root: str):
+    """Parse one file ONCE: ``(ModuleInfo, None)`` or ``(None, SAV001)``."""
     relpath = os.path.relpath(os.path.abspath(path), os.path.abspath(root))
     relpath = relpath.replace(os.sep, "/")
     with open(path, encoding="utf-8") as f:
         source = f.read()
     try:
-        module = ModuleInfo(path, relpath, source)
+        return ModuleInfo(path, relpath, source), None
     except SyntaxError as e:
-        return [
-            Finding(
-                rule="SAV001",
-                severity="error",
-                path=relpath,
-                line=e.lineno or 1,
-                col=e.offset or 0,
-                message=f"file does not parse: {e.msg}",
-                hint="fix the syntax error; savlint checks every file it is pointed at",
-                code="",
-                end_line=e.lineno or 1,
-            )
-        ]
-    findings: list[Finding] = []
-    for rule in rules:
-        for f in rule.check(module):
-            f.path = relpath
-            f.severity = rule.severity
-            f.hint = f.hint or rule.hint
-            if not f.code:
-                f.code = module.function_source_line(f.line)
-            if not f.end_line:
-                f.end_line = f.line
-            findings.append(f)
-    for f in check_pragma_hygiene(module):
-        f.path = relpath
-        findings.append(f)
+        return None, Finding(
+            rule="SAV001",
+            severity="error",
+            path=relpath,
+            line=e.lineno or 1,
+            col=e.offset or 0,
+            message=f"file does not parse: {e.msg}",
+            hint="fix the syntax error; savlint checks every file it is pointed at",
+            code="",
+            end_line=e.lineno or 1,
+        )
+
+
+def _fill_defaults(f: Finding, rule, module: ModuleInfo) -> Finding:
+    f.path = module.relpath
+    f.severity = rule.severity
+    f.hint = f.hint or rule.hint
+    if not f.code:
+        f.code = module.function_source_line(f.line)
+    if not f.end_line:
+        f.end_line = f.line
+    return f
+
+
+def _check_modules(modules: list, rules: list) -> dict:
+    """relpath → findings for per-file AND project rules, unsuppressed.
+
+    Every rule runs against the SAME parsed ``ModuleInfo`` objects (one
+    parse + one ``ast.walk`` per file, shared); project rules see the
+    whole list at once and anchor each finding in its owning module so
+    that module's pragmas apply to it.
+    """
+    from sav_tpu.analysis.rules import check_pragma_hygiene
+
+    by_rel = {m.relpath: m for m in modules}
+    file_rules = [r for r in rules if not isinstance(r, ProjectRule)]
+    project_rules = [r for r in rules if isinstance(r, ProjectRule)]
+    findings: dict[str, list[Finding]] = {m.relpath: [] for m in modules}
+    for module in modules:
+        for rule in file_rules:
+            for f in rule.check(module):
+                findings[module.relpath].append(
+                    _fill_defaults(f, rule, module)
+                )
+        for f in check_pragma_hygiene(module):
+            f.path = module.relpath
+            findings[module.relpath].append(f)
+    for rule in project_rules:
+        for f in rule.check_project(modules):
+            owner = by_rel.get(f.path)
+            if owner is None:  # a rule anchored outside the linted set
+                continue
+            findings[owner.relpath].append(_fill_defaults(f, rule, owner))
+    return findings
+
+
+def lint_file(
+    path: str,
+    root: Optional[str] = None,
+    rules: Optional[list] = None,
+) -> list[Finding]:
+    """All findings for one file, pragma suppression already marked.
+
+    Project rules run with this file as the entire "project" — exactly
+    what the single-file fixtures under tests/analysis_fixtures/ need.
+    """
+    from sav_tpu.analysis.rules import ALL_RULES
+
+    rules = ALL_RULES if rules is None else rules
+    root = root if root is not None else os.getcwd()
+    module, err = _load_module(path, root)
+    if err is not None:
+        return [err]
+    findings = _check_modules([module], rules)[module.relpath]
     _apply_pragmas(findings, module)
     findings.sort(key=lambda f: (f.line, f.col, f.rule))
     return findings
@@ -437,11 +507,23 @@ def lint_paths(
         for r in ALL_RULES
         if (select is None or r.id in select) and r.id not in ignore
     ]
+    root = root if root is not None else os.getcwd()
     all_findings: list[Finding] = []
+    modules: list[ModuleInfo] = []
     files = 0
     for path in iter_python_files(paths):
         files += 1
-        all_findings.extend(lint_file(path, root=root, rules=rules))
+        module, err = _load_module(path, root)
+        if err is not None:
+            all_findings.append(err)
+            continue
+        modules.append(module)
+    per_module = _check_modules(modules, rules)
+    for module in modules:
+        found = per_module[module.relpath]
+        _apply_pragmas(found, module)
+        found.sort(key=lambda f: (f.line, f.col, f.rule))
+        all_findings.extend(found)
     if select is not None:
         all_findings = [
             f for f in all_findings if f.rule in select or f.rule == "SAV001"

@@ -1,0 +1,301 @@
+"""Runtime lock sanitizer: the observed half of the lock-order proof.
+
+SAV122 proves the *static* acquisition graph cycle-free; this module
+checks the claim against reality, in the StepSanitizer tradition of
+"instrument the real run, fail loudly on contract breach". A
+:class:`LockWatch` patches ``threading`` inside chosen sav_tpu modules
+so every ``threading.Lock()`` / ``threading.RLock()`` they construct
+comes back wrapped: each acquire records the per-thread held stack
+(every held lock gains an edge to the newly-acquired one — the
+*observed* acquisition-order graph), each release records the hold
+time. After the run:
+
+- :meth:`LockWatch.cycles` — any cycle in the observed graph is a
+  deadlock that merely hasn't scheduled yet; :meth:`check` raises.
+- :meth:`LockWatch.unexplained_edges` — observed edges missing from the
+  static graph (``build_lock_graph`` must over-approximate the runtime;
+  an unexplained edge means the linter has a blind spot worth filing).
+- :meth:`LockWatch.summary` / :meth:`write` — JSON for post-mortems and
+  the battery's on-chip assertions; ``tools/lockgraph.py`` renders it.
+
+Lock naming matches the static side's identities (``Router._lock``,
+``sav_tpu.ops.attn_tuning._lock``) by inspecting the construction site:
+the enclosing ``self``'s class plus the ``self._x = threading.Lock()``
+source line, or the defining module for bare globals. Locks must be
+constructed INSIDE the patch window — ``with watch.patch(mod): obj =
+mod.Thing()`` — existing locks stay untracked real locks.
+
+Condition/Event/Semaphore pass through untracked: ``Condition`` reaches
+around ``acquire``/``release`` via ``_release_save``/``_acquire_restore``
+and would silently corrupt the held stacks if wrapped naively. The
+repo's modules use bare Lock/RLock, which is exactly what SAV122 models.
+
+Overhead is one dict-free method call and a few list ops per acquire —
+bounded by the lockwatch unit tests so arming chaos runs stays cheap.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import json
+import linecache
+import re
+import sys
+import threading as _threading
+import time
+from typing import Any, Iterable, Optional
+
+
+class LockWatchError(AssertionError):
+    """The observed locking violated the concurrency contract."""
+
+
+_ASSIGN_RE = re.compile(r"(?:self\.(?P<attr>\w+)|(?P<name>\w+))\s*=[^=]")
+
+
+def _name_from_site(frame, default: str) -> str:
+    """Static-graph identity for the lock constructed at ``frame``."""
+    line = linecache.getline(
+        frame.f_code.co_filename, frame.f_lineno
+    ).strip()
+    m = _ASSIGN_RE.match(line)
+    attr = m.group("attr") if m else None
+    bare = m.group("name") if m else None
+    owner = frame.f_locals.get("self")
+    if attr is not None and owner is not None:
+        return f"{type(owner).__name__}.{attr}"
+    if frame.f_code.co_name == "<module>" and bare is not None:
+        return f"{frame.f_globals.get('__name__', 'module')}.{bare}"
+    if bare is not None:
+        return f"{frame.f_globals.get('__name__', 'module')}.{bare}"
+    return default
+
+
+class _TrackedLock:
+    """A Lock/RLock that reports acquire/release to its LockWatch."""
+
+    def __init__(self, watch: "LockWatch", name: str, inner, reentrant: bool):
+        self._watch = watch
+        self.name = name
+        self._inner = inner
+        self._reentrant = reentrant
+        self._depth = _threading.local()  # reentrant depth, per thread
+
+    def acquire(self, blocking: bool = True, timeout: float = -1):
+        got = self._inner.acquire(blocking, timeout)
+        if got:
+            depth = getattr(self._depth, "n", 0)
+            self._depth.n = depth + 1
+            if depth == 0:  # RLock re-entry is not a new acquisition
+                self._watch._note_acquire(self)
+        return got
+
+    def release(self):
+        depth = getattr(self._depth, "n", 1)
+        self._depth.n = depth - 1
+        if depth - 1 == 0:
+            self._watch._note_release(self)
+        self._inner.release()
+
+    def locked(self):
+        return self._inner.locked()
+
+    def __enter__(self):
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc):
+        self.release()
+        return False
+
+    def __repr__(self):
+        return f"<TrackedLock {self.name!r} wrapping {self._inner!r}>"
+
+
+class _ThreadingProxy:
+    """Stand-in for the ``threading`` module inside a patched module.
+
+    ``Lock``/``RLock`` construct tracked wrappers; everything else —
+    ``Thread``, ``Event``, ``Condition``, ``local``, constants — falls
+    through to the real module untouched.
+    """
+
+    def __init__(self, watch: "LockWatch"):
+        self._watch = watch
+
+    def Lock(self):  # noqa: N802 — mirrors the stdlib name
+        return self._watch._make(sys._getframe(1), reentrant=False)
+
+    def RLock(self):  # noqa: N802
+        return self._watch._make(sys._getframe(1), reentrant=True)
+
+    def __getattr__(self, name: str) -> Any:
+        return getattr(_threading, name)
+
+
+class LockWatch:
+    """Collects the observed acquisition graph across tracked locks."""
+
+    def __init__(self, clock=time.perf_counter):
+        self._clock = clock
+        self._mu = _threading.Lock()  # guards the aggregates below
+        self._held = _threading.local()  # per-thread stack of (lock, t0)
+        self._locks: dict[str, int] = {}  # name -> times acquired
+        self._edges: dict[tuple, dict] = {}  # (src, dst) -> {count, threads}
+        self._hold_s: dict[str, float] = {}  # name -> max hold seconds
+        self._serial = 0
+
+    # ------------------------------------------------------ construction
+
+    def _make(self, frame, reentrant: bool) -> _TrackedLock:
+        with self._mu:
+            self._serial += 1
+            default = f"lock#{self._serial}"
+        name = _name_from_site(frame, default)
+        inner = _threading.RLock() if reentrant else _threading.Lock()
+        return _TrackedLock(self, name, inner, reentrant)
+
+    @contextlib.contextmanager
+    def patch(self, *modules):
+        """Swap a tracking ``threading`` into each module's globals.
+
+        Locks the modules construct inside the window are tracked;
+        the originals are restored on exit no matter what raised.
+        """
+        proxy = _ThreadingProxy(self)
+        saved: list = []
+        for mod in modules:
+            if "threading" in mod.__dict__:
+                saved.append((mod, mod.__dict__["threading"]))
+                mod.__dict__["threading"] = proxy
+        try:
+            yield self
+        finally:
+            for mod, real in saved:
+                mod.__dict__["threading"] = real
+
+    # -------------------------------------------------------- recording
+
+    def _stack(self) -> list:
+        stack = getattr(self._held, "stack", None)
+        if stack is None:
+            stack = self._held.stack = []
+        return stack
+
+    def _note_acquire(self, lock: _TrackedLock) -> None:
+        stack = self._stack()
+        tname = _threading.current_thread().name
+        with self._mu:
+            self._locks[lock.name] = self._locks.get(lock.name, 0) + 1
+            for held, _t0 in stack:
+                key = (held.name, lock.name)
+                e = self._edges.setdefault(
+                    key, {"count": 0, "threads": []}
+                )
+                e["count"] += 1
+                if tname not in e["threads"] and len(e["threads"]) < 8:
+                    e["threads"].append(tname)
+        stack.append((lock, self._clock()))
+
+    def _note_release(self, lock: _TrackedLock) -> None:
+        stack = self._stack()
+        for i in range(len(stack) - 1, -1, -1):
+            if stack[i][0] is lock:
+                _l, t0 = stack.pop(i)
+                held_s = self._clock() - t0
+                with self._mu:
+                    if held_s > self._hold_s.get(lock.name, 0.0):
+                        self._hold_s[lock.name] = held_s
+                return
+
+    # -------------------------------------------------------- reporting
+
+    def edges(self) -> list:
+        with self._mu:
+            return [
+                {"src": s, "dst": d, **v}
+                for (s, d), v in sorted(self._edges.items())
+            ]
+
+    def cycles(self) -> list:
+        from sav_tpu.analysis.concurrency import find_cycles
+
+        return find_cycles(self.edges())
+
+    def unexplained_edges(self, static_graph: dict) -> list:
+        """Observed edges the static graph does not predict.
+
+        The static pass must over-approximate the runtime; an observed
+        edge it missed is a linter blind spot (an acquisition through
+        getattr indirection, a callback it could not resolve). Only
+        edges between locks the static side KNOWS about count — helper
+        locks private to a test harness are not a mismatch.
+        """
+        known = {n["id"] for n in static_graph["nodes"]}
+        predicted = {(e["src"], e["dst"]) for e in static_graph["edges"]}
+        return [
+            e
+            for e in self.edges()
+            if e["src"] in known
+            and e["dst"] in known
+            and (e["src"], e["dst"]) not in predicted
+        ]
+
+    def check(self, static_graph: Optional[dict] = None) -> None:
+        """Raise :class:`LockWatchError` on any observed cycle, or any
+        observed edge a provided static graph failed to predict."""
+        cycles = self.cycles()
+        if cycles:
+            loops = "; ".join(" -> ".join(c) for c in cycles)
+            raise LockWatchError(
+                f"observed lock-order cycle(s): {loops} — this schedule "
+                "deadlocks when the interleaving lands the other way"
+            )
+        if static_graph is not None:
+            missing = self.unexplained_edges(static_graph)
+            if missing:
+                listed = "; ".join(
+                    f"{e['src']} -> {e['dst']} (x{e['count']})"
+                    for e in missing
+                )
+                raise LockWatchError(
+                    f"observed acquisition edges the static graph does "
+                    f"not predict: {listed} — SAV122 has a blind spot "
+                    "here; extend the analysis or re-rank the locks"
+                )
+
+    def summary(self) -> dict:
+        with self._mu:
+            hold_ms = {
+                k: round(v * 1e3, 3) for k, v in sorted(self._hold_s.items())
+            }
+            locks = dict(sorted(self._locks.items()))
+        return {
+            "locks": locks,
+            "edges": self.edges(),
+            "cycles": [list(c) for c in self.cycles()],
+            "max_hold_ms": hold_ms,
+        }
+
+    def write(self, path: str) -> dict:
+        doc = self.summary()
+        with open(path, "w", encoding="utf-8") as f:
+            json.dump(doc, f, indent=2, sort_keys=True)
+            f.write("\n")
+        return doc
+
+
+def watch_modules(module_names: Iterable[str], clock=time.perf_counter):
+    """Import-and-patch convenience for drivers (serve_bench/chaos_soak):
+    returns ``(watch, context)`` where entering ``context`` arms tracking
+    in every named module that is importable."""
+    import importlib
+
+    mods = []
+    for name in module_names:
+        try:
+            mods.append(importlib.import_module(name))
+        except ImportError:
+            continue
+    watch = LockWatch(clock=clock)
+    return watch, watch.patch(*mods)

@@ -230,7 +230,7 @@ class JitWithoutDonation(Rule):
         by_name = {}
         for fn in module.functions:
             by_name.setdefault(fn.name, fn)
-        for node in ast.walk(module.tree):
+        for node in module.nodes:
             if not isinstance(node, ast.Call):
                 continue
             if module.resolve_call(node) != "jax.jit" or not node.args:
@@ -398,7 +398,7 @@ class PythonScalarArgRetrace(Rule):
     def check(self, module):
         if not module.jitted_names:
             return
-        for loop in ast.walk(module.tree):
+        for loop in module.nodes:
             if not isinstance(loop, ast.For):
                 continue
             loop_vars = self._int_loop_vars(loop)
@@ -584,7 +584,7 @@ class UnlockedThreadSharedState(Rule):
         return out
 
     def check(self, module):
-        for cls in ast.walk(module.tree):
+        for cls in module.nodes:
             if not isinstance(cls, ast.ClassDef):
                 continue
             methods = [
@@ -683,7 +683,7 @@ class F32LiteralPromotion(Rule):
     def check(self, module):
         if not module.relpath.startswith(BF16_PATHS):
             return
-        for node in ast.walk(module.tree):
+        for node in module.nodes:
             if not isinstance(node, ast.Call):
                 continue
             resolved = module.resolve_call(node)
@@ -785,7 +785,7 @@ class AdhocSeedDerivation(Rule):
     )
 
     def check(self, module):
-        for node in ast.walk(module.tree):
+        for node in module.nodes:
             if not isinstance(node, ast.Call):
                 continue
             if module.resolve_call(node) != "jax.random.PRNGKey":
@@ -1091,7 +1091,7 @@ class BareExitInLibrary(Rule):
         if not module.relpath.startswith(self.LIBRARY_PREFIX):
             return  # CLIs and tools legitimately own process exit
         consumed_funcs = set()
-        for node in ast.walk(module.tree):
+        for node in module.nodes:
             if isinstance(node, ast.Call):
                 resolved = module.resolve_call(node)
                 if resolved in self.EXIT_CALLS:
@@ -1117,7 +1117,7 @@ class BareExitInLibrary(Rule):
                         "except-Exception blocks never see it; raise a "
                         "typed error and let the CLI exit",
                     )
-        for node in ast.walk(module.tree):
+        for node in module.nodes:
             # Bare references (default args, callbacks): handing the
             # hard-exit capability around is how it escapes audit.
             if (
@@ -1376,7 +1376,7 @@ class AdhocPartitionSpec(Rule):
     def check(self, module):
         if module.relpath.startswith(self.LAYOUT_PATHS):
             return
-        for node in ast.walk(module.tree):
+        for node in module.nodes:
             if not isinstance(node, ast.Call):
                 continue
             resolved = module.resolve_call(node)
@@ -1443,7 +1443,7 @@ class UnscaledInt8Cast(Rule):
             or module.relpath in self.EXEMPT
         ):
             return
-        for node in ast.walk(module.tree):
+        for node in module.nodes:
             if not isinstance(node, ast.Call):
                 continue
             dtype_nodes = [
@@ -1545,6 +1545,13 @@ ALL_RULES = [
     RouterTraceHotPathSync(),
     UnscaledInt8Cast(),
 ]
+
+# The whole-program concurrency pass (SAV121–SAV124) lives in its own
+# module — it is the one ProjectRule family and carries the shared
+# lockset/lock-graph analysis tools/lockgraph.py also imports.
+from sav_tpu.analysis.concurrency import CONCURRENCY_RULES  # noqa: E402
+
+ALL_RULES = ALL_RULES + CONCURRENCY_RULES
 
 
 def rule_catalog() -> list[dict]:

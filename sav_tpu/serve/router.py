@@ -227,6 +227,10 @@ class _Job:
 
 _STOP = object()
 
+# Dispatch workers poll their queue at this cadence so a torn-down
+# router can never strand one (see Router._worker).
+_WORKER_POLL_S = 1.0
+
 
 class Router:
     """Admission + load balancing over a serve replica fleet.
@@ -524,8 +528,16 @@ class Router:
     # ------------------------------------------------------------ dispatch
 
     def _worker(self) -> None:
+        # Bounded get (SAV123): close() posts one _STOP per worker, but a
+        # close() that dies mid-teardown must not strand a worker blocked
+        # forever — each timeout re-checks the closed flag and exits.
         while True:
-            job = self._jobs.get()
+            try:
+                job = self._jobs.get(timeout=_WORKER_POLL_S)
+            except _queue_mod.Empty:
+                if self._closed.is_set():
+                    return
+                continue
             if job is _STOP:
                 return
             self._dispatch(job)
@@ -884,12 +896,17 @@ class Router:
         self._refresh_views()
 
     def _maybe_refresh(self) -> None:
+        # Check-and-claim under the lock (SAV121): two dispatch workers
+        # racing the lock-free check both used to decide "stale" and
+        # refresh back-to-back — the claim makes one refresh per cadence.
         now = self._clock()
-        if (
-            self._last_refresh is not None
-            and now - self._last_refresh < self.refresh_secs
-        ):
-            return
+        with self._lock:
+            if (
+                self._last_refresh is not None
+                and now - self._last_refresh < self.refresh_secs
+            ):
+                return
+            self._last_refresh = now
         self._refresh_views()
 
     def _refresh_views(self) -> None:
@@ -900,7 +917,8 @@ class Router:
         on windowed p99 (drain flagged, resume unflagged). Host-only by
         contract — savlint SAV118 owns this body; every value read here
         is a parsed JSON line."""
-        self._last_refresh = self._clock()
+        with self._lock:
+            self._last_refresh = self._clock()
         try:
             views = self._views_fn() or {}
         except Exception:  # noqa: BLE001 — a torn read must not stop routing

@@ -944,17 +944,25 @@ class ServeTelemetry:
             return
         t0 = self._perf()
         now = self.clock()
+        # One consistent counter snapshot (SAV121): the heartbeat thread
+        # reads what request threads write, and a beat catching requests
+        # N with batches from N+1 is a torn line in the fleet record.
+        with self._lock:
+            completed = self._completed
+            batches = self._batches
+            shed = self._shed
+            exemplars = len(self._exemplars)
         record: dict = {
             "up_s": (
                 round(now - self._t_start, 3)
                 if self._t_start is not None else None
             ),
-            "requests": self._completed,
-            "batches": self._batches,
-            "shed": self._shed,
+            "requests": completed,
+            "batches": batches,
+            "shed": shed,
             "w": self.window.snapshot(now),
             "slo": self.slo.state(now),
-            "exemplars": len(self._exemplars),
+            "exemplars": exemplars,
         }
         if self.dtype is not None:
             record["dtype"] = self.dtype

@@ -750,7 +750,10 @@ class Supervisor:
                 except Exception:
                     pass  # a chaos-hook bug must not kill supervision
             try:
-                rc = self.child.wait()
+                # Supervising IS waiting: the CHILD's watchdog bounds the
+                # child (exit-4); the supervisor has no deadline of its
+                # own to enforce on top.
+                rc = self.child.wait()  # savlint: disable=SAV123 -- child liveness is the child watchdog's contract; an outer timeout would re-implement it worse
             finally:
                 if out is not None:
                     out.close()

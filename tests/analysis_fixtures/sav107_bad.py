@@ -6,7 +6,7 @@ class Pipeline:
     def __init__(self):
         self.count = 0
         self.status = "idle"
-        self._thread = threading.Thread(target=self._worker)
+        self._thread = threading.Thread(target=self._worker, daemon=True)
 
     def _worker(self):
         while True:

@@ -6,7 +6,7 @@ class LockedPipeline:
     def __init__(self):
         self.count = 0
         self._lock = threading.Lock()
-        self._thread = threading.Thread(target=self._worker)
+        self._thread = threading.Thread(target=self._worker, daemon=True)
 
     def _worker(self):
         while True:
@@ -22,7 +22,7 @@ class SingleWriter:
     def __init__(self):
         self.fetched = 0
         self.consumed = 0
-        self._thread = threading.Thread(target=self._worker)
+        self._thread = threading.Thread(target=self._worker, daemon=True)
 
     def _worker(self):
         self.fetched += 1  # only the worker writes this: fine

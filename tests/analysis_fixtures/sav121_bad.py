@@ -1,0 +1,23 @@
+"""Known-bad: lock-guarded attribute touched lock-free on a thread path (SAV121)."""
+import threading
+
+
+class Telemetry:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._completed = 0
+        self._window = []
+        self._thread = threading.Thread(target=self._beat, daemon=True)
+
+    def observe(self, ms):
+        with self._lock:
+            self._completed += 1
+            self._window.append(ms)
+
+    def _emit(self):
+        return {"n": self._completed}  # line 18: guarded attr, no lock, reachable
+
+    def _beat(self):
+        while True:
+            self._emit()
+            self._window.clear()  # line 23: guarded attr mutated lock-free

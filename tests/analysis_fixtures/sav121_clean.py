@@ -1,0 +1,28 @@
+"""Known-clean for SAV121: the legitimate neighbors of the lockset rule."""
+import queue
+import threading
+import time
+
+
+class Telemetry:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._clock = time.monotonic  # immutable after __init__: no lock needed
+        self._completed = 0
+        self._inbox = queue.Queue()  # self-synchronizing: exempt
+        self._thread = threading.Thread(target=self._beat, daemon=True)
+
+    def observe(self):
+        with self._lock:
+            self._completed += 1
+
+    def _snapshot_locked(self):
+        # Called ONLY with the lock held: inherits the guard.
+        return {"n": self._completed}
+
+    def _beat(self):
+        while True:
+            t0 = self._clock()  # read-only after init: fine lock-free
+            with self._lock:
+                snap = self._snapshot_locked()
+            self._inbox.put((t0, snap))  # Queue synchronizes itself

@@ -1,0 +1,26 @@
+"""Known-bad: inconsistent lock acquisition order across methods (SAV122).
+
+Also the RUNTIME half's planted inversion: tests import this module and
+drive ``write()`` + ``scan()`` under lockwatch, which must observe the
+same meta->data->meta cycle the static rule reports.
+"""
+import threading
+
+
+class Ledger:
+    def __init__(self):
+        self._meta = threading.Lock()
+        self._data = threading.Lock()
+        self.entries = {}
+        self.revision = 0
+
+    def write(self, key, value):
+        with self._meta:
+            with self._data:  # line 19: meta -> data ...
+                self.entries[key] = value
+                self.revision += 1
+
+    def scan(self):
+        with self._data:
+            with self._meta:  # ... data -> meta: the inversion
+                return dict(self.entries), self.revision

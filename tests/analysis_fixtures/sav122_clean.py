@@ -1,0 +1,40 @@
+"""Known-clean for SAV122: ranked nesting, RLock re-entry, release-then-call."""
+import threading
+
+
+class Ledger:
+    def __init__(self):
+        self._meta = threading.Lock()
+        self._data = threading.Lock()
+        self._state = threading.RLock()
+        self.entries = {}
+        self.revision = 0
+
+    def write(self, key, value):
+        with self._meta:
+            with self._data:  # every path ranks meta before data
+                self.entries[key] = value
+                self.revision += 1
+
+    def scan(self):
+        with self._meta:
+            with self._data:  # same order: a DAG, not a cycle
+                return dict(self.entries), self.revision
+
+    def mutate(self):
+        with self._state:
+            self._helper()  # RLock re-entry via a call: not a cycle
+
+    def _helper(self):
+        with self._state:
+            return self.revision
+
+    def rebuild(self):
+        with self._data:
+            snapshot = dict(self.entries)
+        # Lock released BEFORE calling into other-lock territory.
+        self.audit(snapshot)
+
+    def audit(self, snapshot):
+        with self._meta:
+            return len(snapshot)

@@ -1,0 +1,12 @@
+"""Known-bad: non-daemon threads started and never reaped (SAV124)."""
+import threading
+
+
+def start_logger(fn):
+    t = threading.Thread(target=fn)  # line 6: daemon unset, never joined
+    t.start()
+    return t
+
+
+def fire_and_forget(fn):
+    threading.Thread(target=fn).start()  # line 12: unbound, unreapable

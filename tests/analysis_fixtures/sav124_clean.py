@@ -1,0 +1,22 @@
+"""Known-clean for SAV124: every thread is a daemon or gets joined."""
+import threading
+
+
+def start_daemon(fn):
+    t = threading.Thread(target=fn, daemon=True)  # daemon kwarg
+    t.start()
+    return t
+
+
+def start_marked(fn):
+    t = threading.Thread(target=fn)
+    t.daemon = True  # attribute spelling
+    t.start()
+    return t
+
+
+def run_bounded(fn):
+    t = threading.Thread(target=fn)
+    t.start()
+    t.join(timeout=5.0)  # reaped on the only exit path
+    return t

@@ -1,0 +1,19 @@
+"""Known-bad: unbounded blocking calls in a bounded-contract module (SAV123)."""
+import queue
+import threading
+
+
+class Drain:
+    def __init__(self):
+        self._jobs = queue.Queue()
+        self._gate = threading.Lock()
+        self._thread = threading.Thread(target=self._run, daemon=True)
+
+    def _run(self):
+        job = self._jobs.get()  # line 13: blocks forever on an empty queue
+        self._gate.acquire()  # line 14: blocks forever on a held lock
+        return job
+
+    def stop(self):
+        self._thread.join()  # line 18: blocks forever on a wedged worker
+        return self._jobs.get(timeout=None)  # line 19: spelled-out forever

@@ -1,0 +1,35 @@
+"""Known-clean for SAV123: bounded blocking, plus the zero-arg lookalikes."""
+import queue
+import threading
+
+_POLL_S = 0.5
+
+
+class Drain:
+    def __init__(self):
+        self._jobs = queue.Queue()
+        self._gate = threading.Lock()
+        self._stop = threading.Event()
+        self._thread = threading.Thread(target=self._run, daemon=True)
+
+    def _run(self):
+        while not self._stop.is_set():
+            try:
+                job = self._jobs.get(timeout=_POLL_S)  # bounded: re-checks stop
+            except queue.Empty:
+                continue
+            if self._gate.acquire(timeout=_POLL_S):  # bounded, expiry handled
+                try:
+                    self._handle(job)
+                finally:
+                    self._gate.release()
+
+    def _handle(self, job):
+        del job
+
+    def stop(self, config):
+        self._stop.set()
+        self._thread.join(timeout=5 * _POLL_S)  # bounded join
+        # Zero-arg-needs-an-argument forms are NOT blocking calls:
+        label = ",".join(sorted(config))  # str.join takes an iterable
+        return config.get("mode"), label  # dict.get takes a key

@@ -148,6 +148,23 @@ BAD_EXPECTATIONS = {
         ("SAV120", 9),  # np.asarray(x, np.int8) — positional dtype
         ("SAV120", 10),  # jnp.array(x, dtype=jnp.int8) — kwarg dtype
     ],
+    "sav121_bad.py": [
+        ("SAV121", 18),  # guarded attr read lock-free in a reachable helper
+        ("SAV121", 23),  # guarded attr mutated lock-free in the thread target
+    ],
+    "sav122_bad.py": [
+        ("SAV122", 19),  # meta->data here, data->meta in scan(): a cycle
+    ],
+    "sav_tpu/serve/sav123_bad.py": [
+        ("SAV123", 13),  # Queue.get() with no timeout
+        ("SAV123", 14),  # Lock.acquire() with no timeout
+        ("SAV123", 18),  # Thread.join() with no timeout
+        ("SAV123", 19),  # timeout=None — forever, spelled out
+    ],
+    "sav124_bad.py": [
+        ("SAV124", 6),  # bound thread: daemon unset, never joined
+        ("SAV124", 12),  # unbound fire-and-forget thread
+    ],
 }
 
 CLEAN_FIXTURES = [
@@ -171,6 +188,10 @@ CLEAN_FIXTURES = [
     "sav118_clean.py",
     "sav119_clean.py",
     "sav_tpu/models/sav120_clean.py",
+    "sav121_clean.py",
+    "sav122_clean.py",
+    "sav_tpu/serve/sav123_clean.py",
+    "sav124_clean.py",
 ]
 
 

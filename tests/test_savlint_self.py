@@ -15,6 +15,7 @@ import os
 import subprocess
 import sys
 import textwrap
+import time
 
 from sav_tpu.analysis.lint import (
     DEFAULT_BASELINE,
@@ -28,10 +29,30 @@ SELF_PATHS = [
     os.path.join(ROOT, p) for p in ("sav_tpu", "tools", "train.py", "bench.py")
 ]
 
+_SELF_LINT: dict = {}
+
+
+def _self_lint():
+    """The ONE shared full-surface lint this suite asserts against.
+
+    Half the tests here examine different properties of the same
+    repo-wide run; re-linting (and re-running the whole-program
+    concurrency pass) per test was the suite's own wall-time hotspot.
+    The result is read-only; the first call times itself for the
+    wall-time budget test below.
+    """
+    if not _SELF_LINT:
+        t0 = time.perf_counter()
+        _SELF_LINT["result"] = lint_paths(
+            SELF_PATHS, root=ROOT, baseline=DEFAULT_BASELINE
+        )
+        _SELF_LINT["elapsed_s"] = time.perf_counter() - t0
+    return _SELF_LINT["result"]
+
 
 def test_repo_lints_clean():
     """Zero unsuppressed findings over the whole linted surface."""
-    result = lint_paths(SELF_PATHS, root=ROOT, baseline=DEFAULT_BASELINE)
+    result = _self_lint()
     assert result.findings == [], "\n".join(
         f.format() for f in result.findings
     )
@@ -41,7 +62,7 @@ def test_repo_lints_clean():
 def test_repo_suppressions_are_all_justified():
     """Every pragma carries a justification (SAV100 enforces the text);
     every baseline entry carries one too — no silent exemptions."""
-    result = lint_paths(SELF_PATHS, root=ROOT, baseline=DEFAULT_BASELINE)
+    result = _self_lint()
     assert all(f.rule != "SAV100" for f in result.findings)
     if os.path.exists(DEFAULT_BASELINE):
         for e in load_baseline(DEFAULT_BASELINE):
@@ -73,7 +94,11 @@ def test_trainer_hot_loop_suppressions_are_the_known_set():
     assert rules.count("SAV111") == 0
     assert rules.count("SAV112") == 0
     assert rules.count("SAV113") == 4
-    assert len(suppressed) == 14
+    # + the ONE sanctioned unbounded wait (SAV123): fit's final
+    # checkpointer.wait() — the watchdog is deliberately stopped first
+    # so the flush can take as long as the relay needs.
+    assert rules.count("SAV123") == 1
+    assert len(suppressed) == 15
 
 
 def test_serve_hot_loop_suppressions_are_the_known_set():
@@ -132,7 +157,7 @@ def test_adhoc_partition_spec_suppressions_are_zero():
     carries ZERO suppressions over the whole linted surface, so the one
     source of layout truth cannot erode one pragma at a time
     (docs/parallelism.md)."""
-    result = lint_paths(SELF_PATHS, root=ROOT, baseline=DEFAULT_BASELINE)
+    result = _self_lint()
     assert [f for f in result.findings if f.rule == "SAV117"] == []
     assert [f for f in result.suppressed if f.rule == "SAV117"] == []
 
@@ -143,7 +168,7 @@ def test_unscaled_int8_cast_suppressions_are_zero():
     scale — the rule carries ZERO suppressions over the whole linted
     surface, so scale-less int8 can never creep in one pragma at a time
     (docs/quantization.md)."""
-    result = lint_paths(SELF_PATHS, root=ROOT, baseline=DEFAULT_BASELINE)
+    result = _self_lint()
     assert [f for f in result.findings if f.rule == "SAV120"] == []
     assert [f for f in result.suppressed if f.rule == "SAV120"] == []
 
@@ -170,6 +195,42 @@ def test_library_exit_suppressions_are_the_two_contracts():
     )
     assert sup.findings == []
     assert [f for f in sup.suppressed if f.rule == "SAV114"] == []
+
+
+def test_concurrency_suppressions_are_the_three_sanctioned_waits():
+    """SAV121–SAV124 (ISSUE 18): the repo's locking discipline holds
+    with ZERO suppressions for unguarded state (121), lock-order cycles
+    (122), and thread leaks (124). SAV123's sanctioned unbounded waits
+    stay exactly the documented three: the supervisor's child wait (the
+    child's watchdog owns that liveness), fit's final checkpoint flush
+    (watchdog stopped first, truncation would corrupt the save), and
+    the recorder's crash-path incident dump (a truncated snapshot is a
+    non-replayable bundle). A fourth must extend this list consciously."""
+    result = _self_lint()
+    for rule in ("SAV121", "SAV122", "SAV124"):
+        assert [f for f in result.findings if f.rule == rule] == []
+        assert [f for f in result.suppressed if f.rule == rule] == []
+    sav123 = sorted(
+        os.path.basename(f.path)
+        for f in result.suppressed
+        if f.rule == "SAV123"
+    )
+    assert sav123 == ["recorder.py", "supervisor.py", "trainer.py"]
+
+
+def test_repo_lint_wall_time_stays_bounded():
+    """The shared-parse restructure (each file parsed once, one
+    ``ast.walk`` cached per module, the whole-program pass memoized
+    across the four concurrency rules) keeps the full self-run cheap.
+    The budget is deliberately loose — 4x headroom over the ~2s
+    observed on a cold CI core — but a quadratic regression (a rule
+    re-walking per rule, the project pass re-running per rule) blows
+    through it immediately. Measured on the suite's one shared run —
+    the measurement itself must not double the suite's cost."""
+    result = _self_lint()
+    elapsed = _SELF_LINT["elapsed_s"]
+    assert result.files > 80
+    assert elapsed < 8.0, f"repo lint took {elapsed:.2f}s (budget 8s)"
 
 
 # ------------------------------------------------- the gate actually bites

@@ -392,6 +392,14 @@ def main(argv=None) -> int:
         help="extra raw argument appended to every child command "
         "(repeatable)",
     )
+    parser.add_argument(
+        "--lockwatch", action="store_true",
+        help="run the soak harness under the runtime lock sanitizer "
+        "(sav_tpu.analysis.lockwatch): the supervisor's and killer's "
+        "locks are tracked, the observed acquisition graph lands in "
+        "<log-dir>/lockwatch.json, and any observed lock-order "
+        "inversion fails the soak",
+    )
     parser.add_argument("--json", action="store_true")
     args = parser.parse_args(argv)
 
@@ -425,6 +433,19 @@ def main(argv=None) -> int:
         chaos_env["SAV_CHAOS_HANG_STEP"] = str(args.hang_at_step)
         chaos_env["SAV_CHAOS_ONCE_DIR"] = args.log_dir
 
+    watch = None
+    watch_ctx = None
+    if args.lockwatch:
+        # Arm BEFORE constructing the killer/supervisor — only locks
+        # built inside the patch window are tracked. The killer's lock
+        # lives in this module; the supervisor's in its own.
+        from sav_tpu.analysis.lockwatch import LockWatch
+        from sav_tpu.train import supervisor as _supervisor_mod
+
+        watch = LockWatch()
+        watch_ctx = watch.patch(_supervisor_mod, sys.modules[__name__])
+        watch_ctx.__enter__()
+
     killer = _Killer(args.kills, args.log_dir)
     supervisor = Supervisor(
         _child_argv(args, log_dir=args.log_dir, ckpt_dir=args.ckpt_dir),
@@ -446,6 +467,8 @@ def main(argv=None) -> int:
     killer.start()
     rc = supervisor.run()
     killer.stop()
+    if watch is not None:
+        watch_ctx.__exit__(None, None, None)
     if rc != 0:
         print(f"chaos_soak: supervised chain FAILED (rc {rc})",
               file=sys.stderr)
@@ -480,6 +503,19 @@ def main(argv=None) -> int:
         print("chaos_soak: no supervisor.json written", file=sys.stderr)
         return EXIT_FAILED
     problems, summary = verify_soak(args, chain, killer.kills)
+    if watch is not None:
+        lw = watch.write(os.path.join(args.log_dir, "lockwatch.json"))
+        summary["lockwatch"] = {
+            "locks": len(lw["locks"]),
+            "edges": len(lw["edges"]),
+            "cycles": lw["cycles"],
+        }
+        if lw["cycles"]:
+            problems.append(
+                "lockwatch observed lock-order inversion(s): "
+                + "; ".join(" -> ".join(c) for c in lw["cycles"])
+            )
+            summary["verified"] = False
     if rc != 0:
         problems.insert(0, f"supervised chain exit code {rc}")
         summary["verified"] = False

@@ -1,0 +1,193 @@
+#!/usr/bin/env python
+"""lockgraph CLI — render the repo's lock acquisition-order graph.
+
+The static graph comes from the SAV122 whole-program pass
+(:func:`sav_tpu.analysis.concurrency.build_lock_graph`): nodes are lock
+identities (``Router._lock``, ``sav_tpu.ops.attn_tuning._lock``), an
+edge A→B means B is somewhere acquired while A is held. With
+``--observed`` pointing at a lockwatch JSON (written by an armed
+serve_bench/chaos_soak run), the observed edges are merged in and
+cross-checked: an observed edge the static graph does not predict is a
+linter blind spot and is reported.
+
+    python tools/lockgraph.py                 # text table
+    python tools/lockgraph.py --json          # machine-readable
+    python tools/lockgraph.py --dot > g.dot   # graphviz for post-mortems
+    python tools/lockgraph.py --observed /tmp/serve/lockwatch.json
+
+Exit codes (stable — the battery keys on them):
+  0  clean: the graph (static, plus observed if given) is cycle-free
+     and every observed edge is statically predicted
+  1  cycle: at least one acquisition-order cycle (or an unexplained
+     observed edge) — the details are printed / in the JSON payload
+  2  usage error (bad path, unreadable observed JSON)
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+# Runnable as `python tools/lockgraph.py` from the repo root without an
+# install step: put the checkout on sys.path like the other tools do.
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from sav_tpu.analysis.concurrency import (  # noqa: E402
+    build_lock_graph,
+    find_cycles,
+)
+from sav_tpu.analysis.lint import (  # noqa: E402
+    _load_module,
+    iter_python_files,
+    repo_root,
+)
+
+
+def collect_static_graph(paths, root) -> dict:
+    modules = []
+    for path in iter_python_files(paths):
+        module, err = _load_module(path, root)
+        if err is None:
+            modules.append(module)
+    return build_lock_graph(modules)
+
+
+def _dot(graph: dict, cycles) -> str:
+    cyclic = {n for c in cycles for n in c}
+    lines = ["digraph lockorder {", "  rankdir=LR;"]
+    for n in graph["nodes"]:
+        color = ' color="red"' if n["id"] in cyclic else ""
+        lines.append(
+            f'  "{n["id"]}" [label="{n["id"]}\\n{n["kind"]}"{color}];'
+        )
+    for e in graph["edges"]:
+        site = e["sites"][0] if e.get("sites") else {}
+        label = f'{site.get("path", "")}:{site.get("line", "")}'
+        attrs = f' [label="{label}"]' if label != ":" else ""
+        if e.get("observed_only"):
+            attrs = f' [label="{label}" style=dashed color=orange]'
+        lines.append(f'  "{e["src"]}" -> "{e["dst"]}"{attrs};')
+    lines.append("}")
+    return "\n".join(lines)
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="lockgraph", description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter,
+    )
+    parser.add_argument(
+        "paths", nargs="*",
+        help="files/directories to analyze (default: sav_tpu tools "
+        "train.py bench.py relative to the repo root)",
+    )
+    parser.add_argument(
+        "--json", action="store_true",
+        help="emit the graph + cycle verdict as JSON",
+    )
+    parser.add_argument(
+        "--dot", action="store_true",
+        help="emit graphviz DOT (cycle nodes red, observed-only edges "
+        "dashed orange)",
+    )
+    parser.add_argument(
+        "--observed", default=None,
+        help="lockwatch JSON from an armed run: merge the observed "
+        "edges and fail on any the static graph does not predict",
+    )
+    parser.add_argument(
+        "--root", default=None,
+        help="path the analysis is rooted at (default: repo root)",
+    )
+    args = parser.parse_args(argv)
+
+    root = args.root or repo_root()
+    paths = args.paths or [
+        os.path.join(root, p)
+        for p in ("sav_tpu", "tools", "train.py", "bench.py")
+    ]
+    missing = [p for p in paths if not os.path.exists(p)]
+    if missing:
+        print(
+            f"lockgraph: no such path(s): {', '.join(missing)}",
+            file=sys.stderr,
+        )
+        return 2
+
+    # Validate the observed JSON BEFORE the whole-program parse — a
+    # typo'd path is a usage error the caller should learn in
+    # milliseconds, not after analyzing the repo.
+    observed = None
+    if args.observed is not None:
+        try:
+            with open(args.observed, encoding="utf-8") as f:
+                observed = json.load(f)
+        except (OSError, ValueError) as e:
+            print(f"lockgraph: cannot read observed graph: {e}",
+                  file=sys.stderr)
+            return 2
+
+    graph = collect_static_graph(paths, root)
+    static_edges = {(e["src"], e["dst"]) for e in graph["edges"]}
+    known = {n["id"] for n in graph["nodes"]}
+    unexplained = []
+    if observed is not None:
+        for e in observed.get("edges", []):
+            key = (e["src"], e["dst"])
+            if key in static_edges:
+                continue
+            merged = {
+                "src": e["src"], "dst": e["dst"], "sites": [],
+                "observed_only": True, "count": e.get("count", 1),
+            }
+            graph["edges"].append(merged)
+            # Only locks the static side knows about count as a
+            # mismatch — a harness-private lock is not a blind spot.
+            if e["src"] in known and e["dst"] in known:
+                unexplained.append(merged)
+
+    cycles = find_cycles(graph["edges"])
+    bad = bool(cycles or unexplained)
+
+    if args.json:
+        print(json.dumps({
+            "nodes": graph["nodes"],
+            "edges": graph["edges"],
+            "cycles": [list(c) for c in cycles],
+            "unexplained_observed": unexplained,
+            "clean": not bad,
+        }, indent=2, sort_keys=True))
+    elif args.dot:
+        print(_dot(graph, cycles))
+    else:
+        print(f"{len(graph['nodes'])} locks, {len(graph['edges'])} "
+              "acquisition-order edges")
+        for e in graph["edges"]:
+            site = e["sites"][0] if e.get("sites") else {}
+            where = (
+                f"{site['path']}:{site['line']}" if site
+                else f"observed x{e.get('count', '?')}"
+            )
+            via = f" via {site['via']}" if site.get("via") else ""
+            print(f"  {e['src']} -> {e['dst']}  [{where}{via}]")
+        for c in cycles:
+            print(f"CYCLE: {' -> '.join(c)}", file=sys.stderr)
+        for e in unexplained:
+            print(
+                f"UNEXPLAINED OBSERVED EDGE: {e['src']} -> {e['dst']} "
+                f"(x{e['count']}) — the static graph does not predict "
+                "this acquisition",
+                file=sys.stderr,
+            )
+        verdict = "CYCLIC" if cycles else (
+            "MISMATCH" if unexplained else "cycle-free"
+        )
+        print(f"lockgraph: static+observed graph is {verdict}",
+              file=sys.stderr)
+    return 1 if bad else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

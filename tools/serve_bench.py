@@ -176,6 +176,25 @@ def run_fleet(args, manifest) -> dict:
     from sav_tpu.serve.telemetry import router_views
 
     log_dir = args.log_dir
+    # SAV_LOCKWATCH=1 arms the runtime lock sanitizer around the whole
+    # fleet run: every lock the router/transport/telemetry stack
+    # constructs in THIS process is tracked, and the observed
+    # acquisition-order graph lands in log_dir/lockwatch.json for the
+    # tier-1 inversion-free assertion (docs/concurrency.md).
+    watch = None
+    watch_ctx = None
+    if os.environ.get("SAV_LOCKWATCH"):
+        from sav_tpu.analysis.lockwatch import watch_modules
+
+        watch, watch_ctx = watch_modules([
+            "sav_tpu.serve.router",
+            "sav_tpu.serve.fleet",
+            "sav_tpu.serve.telemetry",
+            "sav_tpu.serve.batcher",
+            "sav_tpu.serve.latency",
+            "sav_tpu.obs.fleet",
+        ])
+        watch_ctx.__enter__()
     delay_rank, delay_s = _parse_inject_delay(args.inject_delay)
     env_fn = None
     if delay_rank is not None and delay_s > 0:
@@ -339,6 +358,9 @@ def run_fleet(args, manifest) -> dict:
         if router is not None:
             router.close()
         pool.stop()
+        if watch is not None:
+            watch_ctx.__exit__(None, None, None)
+            watch.write(os.path.join(log_dir, "lockwatch.json"))
     status = pool.status()
     # Distributed tracing (ISSUE 16): with the router's span ring and
     # the replicas' exports both on disk, run the offline clock-aligned

@@ -1473,6 +1473,119 @@ class UnscaledInt8Cast(Rule):
                 )
 
 
+# ---------------------------------------------------------------- SAV125
+
+
+def _attr_chain(node) -> list:
+    """Lowercased name parts along an attribute chain, root first:
+    ``self.alerts.observe`` -> ``["self", "alerts", "observe"]``."""
+    parts: list = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr.lower())
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id.lower())
+    parts.reverse()
+    return parts
+
+
+class AlertEvalInHotPath(Rule):
+    """Alert evaluation / rollup writes inside request hot paths.
+
+    The fleet metrics pipeline runs at heartbeat cadence by design:
+    ``serve_beat()`` evaluates the alert rules once per beat, the
+    router's heartbeat thread (``_hb_loop`` -> ``_roll_tick``) advances
+    the rollup ladder once per interval, and the bench parent flushes
+    once post-run — so the pipeline's cost is O(rules + new bytes) per
+    *beat*, never per request. Calling ``AlertEngine.observe()`` /
+    ``AlertRule.evaluate()`` or ``Roller.roll_once()/flush()`` from the
+    batcher's submit path, the per-batch telemetry stamps, or the
+    router's admission/dispatch surface would put rule evaluation, JSON
+    encoding, and file appends on the request latency path — the
+    observability regressing the p99 it exists to guard. The scope
+    deliberately overlaps the SAV115/SAV116/SAV118/SAV119 function sets
+    (same hot paths) but reports DIFFERENT calls (pipeline writes, not
+    device syncs), so nothing double-reports.
+    """
+
+    id = "SAV125"
+    name = "alert-eval-in-hot-path"
+    severity = "error"
+    hint = (
+        "alert rules and rollups belong at heartbeat cadence: evaluate "
+        "in serve_beat()/the router heartbeat thread (or post-run), "
+        "never in submit/dispatch/per-batch stamp paths; if a hot-path "
+        "evaluation is truly intentional, pragma it with a "
+        "justification"
+    )
+
+    # The request hot paths: the batcher's submit/forming surface, the
+    # per-batch telemetry stamps, and the router's admission/dispatch
+    # functions. serve_beat/_hb_loop/_roll_tick/router_beat are the
+    # sanctioned cadenced homes and are deliberately NOT in scope.
+    FUNCTIONS = frozenset({
+        # batcher (SAV115's set)
+        "submit", "submit_raw", "next_batch", "_formed_batches",
+        "_place_formed",
+        # per-batch telemetry stamps (SAV116's set, minus serve_beat)
+        "stamp", "begin_trace", "observe_window", "observe_completed",
+        "observe_shed",
+        # router request surface (SAV118 + SAV119's sets, minus
+        # router_beat)
+        "admit", "route", "note_result", "_refresh_views",
+        "_dispatch", "_route_with_waits", "_observe_completion",
+    })
+
+    _ALERT_METHODS = frozenset({"observe", "evaluate"})
+    _ROLL_METHODS = frozenset({"roll_once", "roll", "flush"})
+
+    def check(self, module):
+        for fn in module.functions:
+            if fn.name not in self.FUNCTIONS:
+                continue
+            for node in _walk_excluding_nested(fn):
+                if not isinstance(node, ast.Call):
+                    continue
+                resolved = module.resolve_call(node) or ""
+                if resolved.startswith(
+                    ("sav_tpu.obs.alerts.", "sav_tpu.obs.rollup.")
+                ):
+                    yield _finding(
+                        self,
+                        node,
+                        f"{resolved}() in request hot path {fn.name}() — "
+                        "the metrics pipeline runs at heartbeat cadence, "
+                        "not per request",
+                    )
+                    continue
+                if not isinstance(node.func, ast.Attribute):
+                    continue
+                chain = _attr_chain(node.func)
+                attr = node.func.attr
+                if attr in self._ALERT_METHODS and any(
+                    "alert" in part for part in chain[:-1]
+                ):
+                    yield _finding(
+                        self,
+                        node,
+                        f"alert evaluation (.{attr}() on "
+                        f"{'.'.join(chain[:-1])}) in request hot path "
+                        f"{fn.name}() — rules evaluate once per beat in "
+                        "serve_beat(), not per request",
+                    )
+                elif attr in self._ROLL_METHODS and any(
+                    "roll" in part for part in chain[:-1]
+                ):
+                    yield _finding(
+                        self,
+                        node,
+                        f"rollup write (.{attr}() on "
+                        f"{'.'.join(chain[:-1])}) in request hot path "
+                        f"{fn.name}() — the ladder advances on the "
+                        "router's heartbeat thread, not per request",
+                    )
+
+
 # ----------------------------------------------------------- SAV100 (meta)
 
 
@@ -1544,6 +1657,7 @@ ALL_RULES = [
     RouterHotPathSync(),
     RouterTraceHotPathSync(),
     UnscaledInt8Cast(),
+    AlertEvalInHotPath(),
 ]
 
 # The whole-program concurrency pass (SAV121–SAV124) lives in its own

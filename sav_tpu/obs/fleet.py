@@ -55,6 +55,15 @@ from typing import Callable, Optional
 
 FLEET_SCHEMA = 1
 
+# Forward-compat version stamp (ISSUE 19): every heartbeat line carries
+# ``schema_version`` alongside the frozen legacy ``schema`` field, and
+# the readers in this module tolerate unknown versions and unknown keys
+# (they filter on ``kind`` only, never on version) — so rollup-era and
+# PR-7-era streams coexist in one log dir, and a FUTURE writer's lines
+# still aggregate on today's readers. Bump when a line's meaning (not
+# just its key set) changes.
+FLEET_SCHEMA_VERSION = 2
+
 # Ledger buckets carried by each heartbeat (a subset of goodput.BUCKETS;
 # inlined so this module stays importable without sav_tpu.obs.goodput in
 # odd partial-rsync situations — the names are a stable contract).
@@ -207,6 +216,7 @@ class HeartbeatWriter:
         t0 = self._perf()
         record: dict = {
             "schema": FLEET_SCHEMA,
+            "schema_version": FLEET_SCHEMA_VERSION,
             "kind": "hb",
             "proc": self.process_index,
             "procs": self.process_count,
@@ -270,6 +280,7 @@ class HeartbeatWriter:
         t0 = self._perf()
         record: dict = {
             "schema": FLEET_SCHEMA,
+            "schema_version": FLEET_SCHEMA_VERSION,
             "kind": kind,
             "proc": self.process_index,
             "procs": self.process_count,
@@ -297,6 +308,7 @@ class HeartbeatWriter:
         t0 = self._perf()
         record = {
             "schema": FLEET_SCHEMA,
+            "schema_version": FLEET_SCHEMA_VERSION,
             "kind": "event",
             "event": event,
             "proc": self.process_index,
@@ -328,6 +340,7 @@ class HeartbeatWriter:
                 return
             self._append({
                 "schema": FLEET_SCHEMA,
+                "schema_version": FLEET_SCHEMA_VERSION,
                 "kind": "final",
                 "proc": self.process_index,
                 "step": self.last_step,

@@ -556,6 +556,10 @@ class ServeEngine:
                     self._batcher.stats() if self._batcher else {}
                 ),
                 hbm_fn=_hbm,
+                # Measured capacity stamp (ISSUE 19): the ladder's top
+                # rung over the windowed step — beats publish
+                # capacity_rps, the fleet fold sums it into headroom.
+                max_batch=self.ladder.max_batch,
             )
         self.ledger = LatencyLedger(
             window=(
@@ -1026,6 +1030,13 @@ class ServeEngine:
                     "overhead_s": tele_summary.get("overhead_s"),
                     "autoprof": tele_summary.get("autoprof"),
                 })
+                if tele_summary.get("alerts"):
+                    # notes.alerts: which rules fired and how many
+                    # episodes — "what paged during this run" reads
+                    # from the manifest alone (ISSUE 19).
+                    self.manifest.note(
+                        "alerts", tele_summary["alerts"]
+                    )
             if (
                 self._watermark is not None
                 and self._watermark.source is not None

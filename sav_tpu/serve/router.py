@@ -368,11 +368,25 @@ class Router:
         self.heartbeat_secs = float(heartbeat_secs)
         self._hb_writer = None
         self._hb_thread = None
+        self._roller = None
+        self._last_roll = None
         if log_dir:
             self._hb_writer = HeartbeatWriter(
                 log_dir, process_index=0, stream="router",
                 clock=wall_clock,
             )
+            if self.heartbeat_secs > 0:
+                # The router owns the fleet's rollup ladder: one
+                # single-writer Roller per run, ticked from the
+                # heartbeat thread — never from request paths
+                # (SAV125), never from replica processes (cursor is
+                # single-writer).
+                try:
+                    from sav_tpu.obs.rollup import Roller
+
+                    self._roller = Roller(log_dir)
+                except Exception:
+                    self._roller = None
         for rank in (ranks or ()):
             self._replicas[int(rank)] = _Replica(int(rank))
         self._refresh_views()  # seed the table before the first admit
@@ -836,6 +850,30 @@ class Router:
     def _hb_loop(self) -> None:
         while not self._closed.wait(self.heartbeat_secs):
             self.router_beat()
+            self._roll_tick()
+
+    def _roll_tick(self, min_interval_s: float = 2.0) -> None:
+        """Advance the fleet rollup ladder by the bytes appended since
+        the last tick. Cadenced work, deliberately outside
+        ``router_beat`` (SAV119 scope) and every request path
+        (SAV125): O(new bytes) per tick, and a failed roll must never
+        take the heartbeat with it. Ticks are rate-limited below the
+        heartbeat cadence (the finest bucket is 10s — sub-second rolls
+        only steal GIL slices from request threads); close() passes 0
+        so the final fold always runs."""
+        if self._roller is None:
+            return
+        now = self._clock()
+        if (
+            self._last_roll is not None
+            and now - self._last_roll < min_interval_s
+        ):
+            return
+        self._last_roll = now
+        try:
+            self._roller.roll_once()
+        except Exception:
+            pass
 
     # ----------------------------------------------------- replica states
 
@@ -1033,6 +1071,9 @@ class Router:
             # orderly final record.
             self._hb_writer.serve_beat(self.live(), kind="router")
             self._hb_writer.close()
+        # Fold the final beats into the rollup ladder so post-run
+        # readers (console, headroom fold) see the whole run.
+        self._roll_tick(min_interval_s=0.0)
         if self.log_dir:
             with self._lock:
                 records = self._ring.records()

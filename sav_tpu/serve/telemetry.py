@@ -74,6 +74,8 @@ import time
 from collections import deque
 from typing import Callable, Optional
 
+from sav_tpu.obs import alerts as alerts_mod
+from sav_tpu.obs import rollup as rollup_mod
 from sav_tpu.obs.fleet import (
     MAD_SCALE,
     _mad,
@@ -708,6 +710,8 @@ class ServeTelemetry:
         autoprof=None,
         queue_stats_fn: Optional[Callable[[], dict]] = None,
         hbm_fn: Optional[Callable[[], Optional[dict]]] = None,
+        max_batch: Optional[int] = None,
+        alerts="auto",
     ):
         self.log_dir = log_dir
         # Weight-serving dtype stamp ("bf16" | "f32" | "int8" — ISSUE
@@ -733,6 +737,32 @@ class ServeTelemetry:
         self.heartbeat_secs = float(heartbeat_secs)
         self.writer = writer
         self.autoprof = autoprof
+        # Measured capacity (ISSUE 19): the ladder's top rung over the
+        # windowed per-batch step — rows/s this replica can actually
+        # sustain, published as ``capacity_rps`` in every beat. None
+        # (pre-fleet callers) publishes nothing.
+        self.max_batch = int(max_batch) if max_batch else None
+        # Declarative alert rules (ISSUE 19): "auto" arms the built-in
+        # SLO burn rule (parity-gated against SLOTracker) plus any
+        # operator rules from the SAV_ALERT_RULES env seam (a JSON file
+        # path — replicas inherit the parent's env through the pool, so
+        # a fleet arms without flag plumbing). Pass an AlertEngine to
+        # own the rule set outright, or None to disarm. Evaluation runs
+        # at heartbeat cadence only (savlint SAV125).
+        if alerts == "auto":
+            alerts = None
+            if writer is not None:
+                rules = alerts_mod.default_rules(slo_burn_threshold)
+                source = os.environ.get("SAV_ALERT_RULES")
+                if source:
+                    rules = rules + alerts_mod.load_rules(source)
+                alerts = alerts_mod.AlertEngine(
+                    rules,
+                    log_dir=log_dir,
+                    proc=getattr(writer, "process_index", None),
+                    clock=wall_clock,
+                )
+        self.alerts = alerts
         self._queue_stats_fn = queue_stats_fn
         self._hbm_fn = hbm_fn
         self._lock = threading.Lock()
@@ -966,6 +996,17 @@ class ServeTelemetry:
         }
         if self.dtype is not None:
             record["dtype"] = self.dtype
+        # Measured capacity: top ladder rung / windowed per-batch step
+        # (rows per second at full batches). Published only once the
+        # window has a measured step — capacity is a measurement, not a
+        # spec sheet (absent beats are skipped by the fold, not zeroed).
+        step = record["w"].get("step_s_avg")
+        if (
+            self.max_batch
+            and isinstance(step, (int, float))
+            and step > 0
+        ):
+            record["capacity_rps"] = round(self.max_batch / step, 2)
         if self._queue_stats_fn is not None:
             try:
                 qs = self._queue_stats_fn() or {}
@@ -983,6 +1024,18 @@ class ServeTelemetry:
                 pass
         if self.autoprof is not None:
             record["captures"] = len(self.autoprof.captures)
+        if self.alerts is not None:
+            # Rule evaluation rides the beat cadence (the ONE sanctioned
+            # home — savlint SAV125 keeps it out of the request paths);
+            # active rule names stamp the line so a beat stream alone
+            # shows what was firing when.
+            try:
+                self.alerts.observe(record, now=self._wall())
+                active = self.alerts.active()
+                if active:
+                    record["alerts"] = active
+            except Exception:
+                pass  # a broken rule must not stop heartbeating
         appended = self.writer.serve_beat(record)
         with self._lock:
             # Count only beats actually appended — a dropped (lock
@@ -1007,6 +1060,15 @@ class ServeTelemetry:
         if self.writer is not None:
             self.serve_beat()
             self.writer.close(outcome)
+        if self.alerts is not None:
+            # An episode cannot outlive its emitter: the final beat
+            # above was its last chance to resolve on data; whatever is
+            # still firing resolves here (exactly one resolved event
+            # per open episode — the once-per-episode contract).
+            try:
+                self.alerts.finalize(self._wall())
+            except Exception:
+                pass
         if self.autoprof is not None:
             try:
                 self.autoprof.finalize(self._batches)
@@ -1048,6 +1110,8 @@ class ServeTelemetry:
         out["window"] = self.window.snapshot()
         if self.autoprof is not None:
             out["autoprof"] = self.autoprof.stats()
+        if self.alerts is not None:
+            out["alerts"] = self.alerts.state()
         return out
 
     def stats(self) -> dict:
@@ -1182,6 +1246,8 @@ def aggregate_serve(
             "inflight": last.get("inflight"),
             "p99_ms": w.get("p99_ms"),
             "throughput_rps": w.get("throughput_rps"),
+            "capacity_rps": last.get("capacity_rps"),
+            "alerts": last.get("alerts") or [],
             "step_s_avg": w.get("step_s_avg"),
             "queue_depth": w.get("queue_depth_last"),
             "occupancy": w.get("occupancy"),
@@ -1232,8 +1298,77 @@ def aggregate_serve(
             int(p) for p, v in summary["replicas"].items() if v.get("burning")
         ),
         "suspects": sorted(s["proc"] for s in suspects),
+        "alerts": sorted({
+            name for v in replicas for name in (v.get("alerts") or [])
+        }),
     }
+    _fold_capacity(summary, log_dir)
     return summary
+
+
+#: Projection horizon for the headroom fold: one fast SLO window ahead
+#: — far enough that a building ramp shows, near enough that the
+#: Theil–Sen slope over the finest rollup tier is still predictive.
+HEADROOM_HORIZON_S = 60.0
+
+
+def _fold_capacity(summary: dict, log_dir: str) -> None:
+    """The ISSUE-19 capacity/headroom fold on ``summary["fleet"]``:
+
+    - ``capacity_rps``: sum of the replicas' measured ``capacity_rps``
+      stamps (absent stamps are SKIPPED, not zero-filled — capacity is
+      a measurement; a fleet with no measured replica has no capacity
+      number and therefore no headroom number, the sentinel's
+      skip-don't-fabricate rule).
+    - ``projected_rps``: robust-slope projection of fleet throughput
+      over the finest rollup tier (:func:`sav_tpu.obs.rollup
+      .project_load` — Theil–Sen, so one straggling bucket cannot bend
+      the forecast), falling back to the beat timeline when nothing has
+      been rolled yet.
+    - ``headroom_frac``: ``(capacity - projected) / capacity``, clamped
+      to [-1, 1] — the ROADMAP item-3 autoscaler/weighted-routing
+      input, sentinel-gated as ``fleet_headroom_frac``.
+    """
+    fleet = summary["fleet"]
+    replicas = summary["replicas"].values()
+    capacity = [
+        v["capacity_rps"] for v in replicas
+        if isinstance(v.get("capacity_rps"), (int, float))
+    ]
+    if not capacity or sum(capacity) <= 0:
+        return
+    fleet["capacity_rps"] = round(sum(capacity), 2)
+    points = []
+    try:
+        res, lines = rollup_mod.finest_rollup(log_dir)
+        if res is not None:
+            points = [
+                (t, v)
+                for t, v in rollup_mod.series(lines, "throughput_rps")
+            ]
+    except Exception:
+        points = []
+    if not points:
+        # Nothing rolled yet: the beat timeline carries per-replica rps
+        # at beat cadence; sum per timestamp bucket (1s) as a stand-in.
+        per_t: dict = {}
+        for entry in summary.get("timeline") or []:
+            t, v = entry.get("t"), entry.get("rps")
+            if isinstance(t, (int, float)) and isinstance(v, (int, float)):
+                per_t[int(t)] = per_t.get(int(t), 0.0) + float(v)
+        points = sorted(per_t.items())
+    projection = rollup_mod.project_load(
+        points, horizon_s=HEADROOM_HORIZON_S
+    )
+    if projection is None:
+        return
+    fleet["load_rps"] = projection["now_rps"]
+    fleet["load_slope_rps_per_s"] = projection["slope_rps_per_s"]
+    fleet["projected_rps"] = projection["projected_rps"]
+    raw = (fleet["capacity_rps"] - projection["projected_rps"]) / (
+        fleet["capacity_rps"]
+    )
+    fleet["headroom_frac"] = round(max(min(raw, 1.0), -1.0), 4)
 
 
 #: Default per-stream read bound for the LIVE router view: enough for

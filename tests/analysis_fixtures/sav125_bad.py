@@ -1,0 +1,33 @@
+"""Known-bad fixture for SAV125: the metrics pipeline dragged onto the
+request latency path — alert-rule evaluation in the batcher's dequeue
+loop and the router's admission check, a rollup advance in the dispatch
+worker, and a resolved module call into the alert engine from the
+per-batch telemetry stamp."""
+from sav_tpu.obs import alerts
+
+
+class Batcher:
+    def next_batch(self):
+        batch = self._form()
+        self.alerts.observe({"w": {"queue_depth": len(batch)}})
+        return batch
+
+
+class Router:
+    def admit(self, payload):
+        if self.alert_rule.evaluate({"w": {"inflight": self.inflight}}):
+            raise RuntimeError("shedding")
+        return self._enqueue(payload)
+
+    def _dispatch(self, job):
+        self.roller.roll_once()
+        self._send(job)
+
+
+class Telemetry:
+    def observe_completed(self, latency_ms):
+        events = alerts.AlertEngine(self.rules).observe(
+            {"w": {"p99_ms": latency_ms}}
+        )
+        self.window.note(latency_ms)
+        return events

@@ -1,0 +1,33 @@
+"""Known-clean fixture for SAV125: the pipeline at its sanctioned
+cadence — rules evaluate once per beat in serve_beat(), the rollup
+ladder advances on the router's heartbeat thread, and the hot paths
+only touch their own windows/counters (a .observe() on a non-alert
+window is the SlidingWindow idiom, not rule evaluation)."""
+
+
+class Telemetry:
+    def serve_beat(self):
+        # Sanctioned home: once per heartbeat interval, not per request.
+        record = {"w": self.window.snapshot()}
+        self.alerts.observe(record)
+        return self.writer.serve_beat(record)
+
+    def observe_completed(self, latency_ms):
+        # Hot path touches its own window — .observe() on a non-alert
+        # chain is the latency fold, not rule evaluation.
+        self.window.observe(latency_ms)
+
+
+class Router:
+    def _hb_loop(self):
+        while not self._closed.wait(self.heartbeat_secs):
+            self.router_beat()
+            self._roll_tick()
+
+    def _roll_tick(self):
+        # Sanctioned home: the ladder advances at heartbeat cadence.
+        self.roller.roll_once()
+
+    def _dispatch(self, job):
+        self._send(job)
+        self.stamps.append(("sent", job.rid))

@@ -1,0 +1,199 @@
+"""Declarative alert rules (ISSUE 19): rule parsing, the
+firing/resolved state machine with flap suppression, the events file,
+and the bit-for-bit SLO-burn parity gate against PR 11's SLOTracker.
+
+File-only and clock-injected — no processes, no sleeps."""
+
+import json
+import os
+
+import pytest
+
+from sav_tpu.obs.alerts import (
+    AlertEngine,
+    AlertRule,
+    alerts_path,
+    default_rules,
+    episodes,
+    load_rules,
+    read_alerts,
+    slo_burn_rule,
+)
+
+
+# ------------------------------------------------------------ rule shape
+
+
+def test_rule_evaluate_and_shapes():
+    rule = AlertRule(
+        "hot-queue",
+        when=[("w.queue_depth", ">", 10), ("w.p99_ms", ">=", 50.0)],
+    )
+    assert rule.evaluate(
+        {"w": {"queue_depth": 11, "p99_ms": 50.0}}
+    ) is True
+    # AND-composed: one false conjunct kills the condition.
+    assert rule.evaluate(
+        {"w": {"queue_depth": 11, "p99_ms": 49.0}}
+    ) is False
+    # Missing metric / non-numeric / bool -> False, never a throw (the
+    # SLOTracker None-window semantics, generalized).
+    assert rule.evaluate({}) is False
+    assert rule.evaluate({"w": {"queue_depth": "11", "p99_ms": 60}}) is False
+    assert rule.evaluate({"w": {"queue_depth": True, "p99_ms": 60}}) is False
+    # Round-trips through the JSON shape, shorthand included.
+    doc = rule.to_dict()
+    again = AlertRule.from_dict(doc)
+    assert again.to_dict() == doc
+    with pytest.raises(ValueError):
+        AlertRule("bad-op", when=[("x", "~", 1)])
+
+
+def test_load_rules_sources(tmp_path):
+    doc = {"rules": [
+        {"name": "lat", "severity": "warn", "for_s": 2,
+         "when": [{"metric": "w.p99_ms", "op": ">", "value": 40}]},
+    ]}
+    path = os.path.join(str(tmp_path), "rules.json")
+    with open(path, "w") as f:
+        json.dump(doc, f)
+    for source in (path, json.dumps(doc), json.dumps(doc["rules"])):
+        rules = load_rules(source)
+        assert [r.name for r in rules] == ["lat"]
+        assert rules[0].for_s == 2.0
+    # Errors are loud — a silently-dropped page rule is the worst bug.
+    with pytest.raises(ValueError):
+        load_rules(os.path.join(str(tmp_path), "missing.json"))
+    with pytest.raises(ValueError):
+        load_rules('{"rules": [{"severity": "warn"}]}')  # nameless
+
+
+# ---------------------------------------------------------- state machine
+
+
+def test_firing_resolved_episode(tmp_path):
+    d = str(tmp_path)
+    rule = AlertRule(
+        "lat", when=[("w.p99_ms", ">", 40)], for_s=2.0, resolve_s=3.0,
+    )
+    eng = AlertEngine([rule], log_dir=d, proc=0)
+    hot = {"w": {"p99_ms": 80.0}}
+    cool = {"w": {"p99_ms": 10.0}}
+    # Pending during for_s: no event until the condition HELD 2s.
+    assert eng.observe(hot, now=100.0) == []
+    assert eng.observe(hot, now=101.0) == []
+    events = eng.observe(hot, now=102.0)
+    assert [e["event"] for e in events] == ["firing"]
+    # Once-per-episode dedupe: still firing, no repeat event.
+    assert eng.observe(hot, now=103.0) == []
+    # Cooling during resolve_s; a flap back suppresses the resolve.
+    assert eng.observe(cool, now=104.0) == []
+    assert eng.observe(hot, now=105.0) == []   # flap: same episode
+    assert eng.observe(cool, now=106.0) == []
+    events = eng.observe(cool, now=109.5)      # held cool 3s
+    assert [e["event"] for e in events] == ["resolved"]
+    # On-disk events mirror the returned ones, with provenance.
+    on_disk = read_alerts(d)
+    assert [(e["event"], e["rule"], e["proc"]) for e in on_disk] == [
+        ("firing", "lat", 0), ("resolved", "lat", 0),
+    ]
+    eps = episodes(on_disk)
+    assert eps["lat"]["fired"] == 1 and eps["lat"]["resolved"] == 1
+    assert eps["lat"]["active"] is False
+    # A fresh excursion is a NEW episode.
+    eng.observe(hot, now=200.0)
+    events = eng.observe(hot, now=202.5)
+    assert [e["episode"] for e in events] == [2]
+
+
+def test_zero_holds_transition_within_one_observe():
+    rule = AlertRule("insta", when=[("x", ">", 0)], for_s=0, resolve_s=0)
+    eng = AlertEngine([rule])
+    assert [e["event"] for e in eng.observe({"x": 1}, now=1.0)] == ["firing"]
+    assert eng.active() == ["insta"]
+    assert [e["event"] for e in eng.observe({"x": 0}, now=2.0)] == ["resolved"]
+    assert eng.active() == []
+
+
+def test_finalize_resolves_open_episodes(tmp_path):
+    d = str(tmp_path)
+    rule = AlertRule("lat", when=[("x", ">", 0)], for_s=0, resolve_s=60.0)
+    eng = AlertEngine([rule], log_dir=d)
+    eng.observe({"x": 1}, now=10.0)
+    events = eng.finalize(now=11.0)
+    assert [e["event"] for e in events] == ["resolved"]
+    eps = episodes(read_alerts(d))
+    assert eps["lat"] == {
+        "fired": 1, "resolved": 1, "active": False,
+        "severity": "warn", "last_t": 11.0,
+    }
+    # Idempotent: a second finalize emits nothing.
+    assert eng.finalize(now=12.0) == []
+
+
+def test_engine_state_and_torn_events(tmp_path):
+    d = str(tmp_path)
+    eng = AlertEngine(
+        [AlertRule("a", when=[("x", ">", 0)]),
+         AlertRule("b", when=[("y", ">", 0)], severity="page")],
+        log_dir=d,
+    )
+    eng.observe({"x": 1, "y": 0}, now=1.0)
+    state = eng.state()
+    assert state["active"] == ["a"]
+    assert state["episodes"] == {"a": 1}
+    assert state["emitted"] == 1 and state["dropped"] == 0
+    assert state["rules"] == 2
+    # Torn tail + foreign lines are skipped by the reader.
+    with open(alerts_path(d), "a") as f:
+        f.write('{"kind": "other"}\n')
+        f.write('{"kind": "alert", "event": "fir')
+    assert [e["rule"] for e in read_alerts(d)] == ["a"]
+
+
+# ------------------------------------------------------- SLO parity gate
+
+
+def test_slo_burn_rule_bit_for_bit_parity_with_slotracker():
+    """The ISSUE 19 acceptance gate: the declarative slo-burn rule,
+    replayed over a beat stream, is firing EXACTLY when SLOTracker says
+    ``burning`` — byte-identical decisions at every beat, including the
+    None-window edges (missing burn -> not firing)."""
+    from sav_tpu.serve.telemetry import SLOTracker
+
+    tracker = SLOTracker(
+        target=0.99, fast_window_s=60.0, slow_window_s=600.0,
+        burn_threshold=2.0, clock=lambda: 0.0,
+    )
+    rule = slo_burn_rule(2.0)
+    eng = AlertEngine([rule])
+    # A replayed outcome stream: healthy -> heavy misses -> recovery.
+    # (8% misses burns at 8x the budget: over threshold in both
+    # windows once the slow window accumulates.)
+    phases = (
+        [(0, 50)] * 30           # healthy
+        + [(4, 50)] * 120        # sustained 8% miss burn
+        + [(0, 50)] * 700        # recovery (slow window drains)
+    )
+    decisions = []
+    for i, (misses, n) in enumerate(phases):
+        now = float(i)
+        tracker.observe_outcomes(misses, n, now=now)
+        slo = tracker.state(now=now)
+        beat = {"slo": slo}           # exactly what serve_beat stamps
+        eng.observe(beat, now=now)
+        decisions.append((slo["burning"], "slo-burn" in eng.active()))
+    mismatches = [i for i, (a, b) in enumerate(decisions) if a != b]
+    assert mismatches == []
+    # And the stream actually exercised both sides of the edge.
+    assert any(a for a, _ in decisions)
+    assert decisions[0][0] is False and decisions[-1][0] is False
+
+
+def test_default_rules_are_the_slo_rule():
+    rules = default_rules(3.0)
+    assert [r.name for r in rules] == ["slo-burn"]
+    assert rules[0].severity == "page"
+    assert list(rules[0].when) == [
+        ("slo.burn_fast", ">", 3.0), ("slo.burn_slow", ">", 3.0),
+    ]

@@ -82,6 +82,13 @@ def test_heartbeat_writer_appends_schema_records(tmp_path):
     records = [json.loads(ln) for ln in open(path) if ln.strip()]
     kinds = [r["kind"] for r in records]
     assert kinds == ["hb", "hb", "event", "final"]
+    # schema_version (ISSUE 19): every record kind carries the writer's
+    # generation stamp next to the frozen line-shape schema.
+    from sav_tpu.obs.fleet import FLEET_SCHEMA_VERSION
+
+    assert FLEET_SCHEMA_VERSION == 2
+    assert [r["schema_version"] for r in records] == [2, 2, 2, 2]
+    assert all(r["schema"] == 1 for r in records)
     hb = records[0]
     assert hb["proc"] == 3 and hb["procs"] == 8 and hb["step"] == 10
     assert hb["b"]["step"] == 2.0 and hb["b"]["input_wait"] == 0.5
@@ -105,6 +112,35 @@ def test_read_heartbeats_skips_torn_tail(tmp_path):
         f.write('{"kind": "hb", "step"')  # a killed writer's torn line
     records = read_heartbeats(str(tmp_path))[0]
     assert [r["kind"] for r in records] == ["hb", "final"]
+
+
+def test_readers_tolerate_future_schema_versions(tmp_path):
+    """Forward compat (ISSUE 19): a NEWER writer's records — higher
+    schema_version, unknown keys, even unknown kinds — pass through the
+    readers untouched; old readers filter on ``kind`` and must never
+    crash or drop on a version bump."""
+    path = heartbeat_path(str(tmp_path), 0)
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    with open(path, "w") as f:
+        f.write(json.dumps({
+            "schema": 1, "schema_version": 99, "kind": "hb", "proc": 0,
+            "t": 1.0, "step": 5, "from_the_future": {"x": 1},
+        }) + "\n")
+        f.write(json.dumps({
+            "schema": 1, "schema_version": 99, "kind": "hologram",
+            "proc": 0, "t": 2.0,
+        }) + "\n")
+        f.write(json.dumps({
+            "schema": 1, "schema_version": 99, "kind": "final",
+            "proc": 0, "t": 3.0, "outcome": "ok",
+        }) + "\n")
+    records = read_heartbeats(str(tmp_path))[0]
+    assert [r["kind"] for r in records] == ["hb", "hologram", "final"]
+    assert records[0]["from_the_future"] == {"x": 1}
+    # Aggregation sees through the unknown records too.
+    summary = aggregate_fleet(str(tmp_path))
+    proc = summary["processes"]["0"]
+    assert proc["outcome"] == "ok"
 
 
 # ------------------------------------------------------- aggregation unit

@@ -165,6 +165,12 @@ BAD_EXPECTATIONS = {
         ("SAV124", 6),  # bound thread: daemon unset, never joined
         ("SAV124", 12),  # unbound fire-and-forget thread
     ],
+    "sav125_bad.py": [
+        ("SAV125", 12),  # .observe() on an alert engine in next_batch()
+        ("SAV125", 18),  # .evaluate() on an alert rule in admit()
+        ("SAV125", 23),  # .roll_once() on the roller in _dispatch()
+        ("SAV125", 29),  # resolved sav_tpu.obs.alerts call in a stamp
+    ],
 }
 
 CLEAN_FIXTURES = [
@@ -192,6 +198,7 @@ CLEAN_FIXTURES = [
     "sav122_clean.py",
     "sav_tpu/serve/sav123_clean.py",
     "sav124_clean.py",
+    "sav125_clean.py",
 ]
 
 

@@ -143,6 +143,15 @@ def test_router_hot_path_suppressions_are_zero():
     # exceptions.
     assert [f for f in result.findings if f.rule == "SAV119"] == []
     assert [f for f in result.suppressed if f.rule == "SAV119"] == []
+    # SAV125 (alert-eval-in-hot-path, ISSUE 19): the metrics pipeline
+    # stays at heartbeat cadence with ZERO suppressions — across the
+    # serving stack AND the pipeline's own modules (sav_tpu/obs):
+    # alert evaluation lives in serve_beat(), rollup advances on the
+    # router's heartbeat thread, never in a request path.
+    obs = lint_paths([os.path.join(ROOT, "sav_tpu", "obs")], root=ROOT)
+    for res in (result, obs):
+        assert [f for f in res.findings if f.rule == "SAV125"] == []
+        assert [f for f in res.suppressed if f.rule == "SAV125"] == []
     for module in ("router.py", "fleet.py"):
         one = lint_paths(
             [os.path.join(ROOT, "sav_tpu", "serve", module)], root=ROOT

@@ -59,6 +59,8 @@ def test_router_fleet_surface_is_structurally_jax_free():
         "import sys\n"
         "import sav_tpu.serve.router, sav_tpu.serve.fleet\n"
         "import sav_tpu.serve.telemetry\n"
+        "import sav_tpu.obs.rollup, sav_tpu.obs.alerts\n"
+        "import tools.fleet_console\n"
         "assert 'jax' not in sys.modules, 'fleet surface imported jax'\n"
         "assert 'numpy' not in sys.modules\n"
         "print('CLEAN')\n"
@@ -588,20 +590,24 @@ def test_tcp_transport_declares_the_stamp_seam():
 
 
 def _write_serve_stream(log_dir, proc, times, *, pid=1000, final=False,
-                        step_s=0.01, queued=0, inflight=0, p99=12.0):
+                        step_s=0.01, queued=0, inflight=0, p99=12.0,
+                        capacity=None, rps=50.0):
     os.makedirs(os.path.join(log_dir, "fleet"), exist_ok=True)
     path = os.path.join(log_dir, "fleet", f"proc_{proc}.jsonl")
     with open(path, "a") as f:
         for t in times:
-            f.write(json.dumps({
+            record = {
                 "schema": 1, "kind": "serve", "proc": proc, "procs": 2,
                 "t": t, "pid": pid, "queued": queued, "inflight": inflight,
                 "requests": 10, "shed": 0,
                 "w": {"p99_ms": p99, "step_s_avg": step_s,
-                      "queue_depth_last": queued, "throughput_rps": 50.0},
+                      "queue_depth_last": queued, "throughput_rps": rps},
                 "slo": {"hit_frac": 1.0, "burn_rate": 0.0,
                         "burning": False},
-            }) + "\n")
+            }
+            if capacity is not None:
+                record["capacity_rps"] = capacity
+            f.write(json.dumps(record) + "\n")
         if final:
             f.write(json.dumps({
                 "schema": 1, "kind": "final", "proc": proc,
@@ -681,6 +687,51 @@ def test_stale_final_does_not_close_a_restarted_replica(tmp_path):
     assert views[1]["suspect"] is False
 
 
+def test_aggregate_serve_folds_capacity_and_headroom(tmp_path):
+    """ISSUE 19: with capacity stamps on the beats and a rolled
+    throughput series, aggregate_serve folds fleet capacity, the
+    Theil–Sen load projection, and headroom_frac; without stamps the
+    fold stays silent (skip-not-zero-fill)."""
+    from sav_tpu.obs.rollup import Roller
+    from sav_tpu.serve.telemetry import aggregate_serve
+
+    log_dir = str(tmp_path)
+    # 40 beats at 1 Hz per replica, throughput climbing 1 rps/s each.
+    for proc in (0, 1):
+        _write_serve_stream(
+            log_dir, proc,
+            [float(t) for t in range(40)],
+            capacity=400.0, rps=100.0,
+        )
+    roller = Roller(log_dir)
+    roller.roll_once()
+    roller.flush()
+    summary = aggregate_serve(log_dir, now=40.0)
+    fleet = summary["fleet"]
+    assert summary["replicas"]["0"]["capacity_rps"] == 400.0
+    assert fleet["capacity_rps"] == 800.0
+    # Flat 100 rps per replica -> flat 200 rps projection, headroom
+    # (800 - 200) / 800 = 0.75.
+    assert fleet["projected_rps"] == pytest.approx(200.0, rel=0.01)
+    assert fleet["headroom_frac"] == pytest.approx(0.75, abs=0.01)
+    assert fleet["load_rps"] == pytest.approx(200.0, rel=0.01)
+    # Un-rolled dir: the beat-timeline fallback still projects.
+    bare = str(tmp_path / "bare")
+    for proc in (0, 1):
+        _write_serve_stream(
+            bare, proc, [float(t) for t in range(11)], capacity=150.0,
+        )
+    fleet2 = aggregate_serve(bare, now=11.0)["fleet"]
+    assert fleet2["capacity_rps"] == 300.0
+    assert isinstance(fleet2["headroom_frac"], float)
+    # No capacity stamps anywhere -> NO capacity/headroom keys.
+    plain = str(tmp_path / "plain")
+    _write_serve_stream(plain, 0, [0.0, 1.0, 2.0])
+    fleet3 = aggregate_serve(plain, now=3.0)["fleet"]
+    assert "capacity_rps" not in fleet3
+    assert "headroom_frac" not in fleet3
+
+
 def test_read_heartbeats_tail_bound_reads_recent_lines_only(tmp_path):
     """The router's live view is tail-bounded: a refresh parses only
     each stream's trailing bytes (constant cost however long the run),
@@ -728,6 +779,43 @@ def test_sentinel_scores_fleet_fixtures_both_directions(capsys):
     out = capsys.readouterr().out
     assert "fleet_p99_latency_ms" in out
     assert "fleet_throughput" in out
+
+
+def test_sentinel_scores_headroom_both_directions(capsys):
+    """fleet_headroom_frac (ISSUE 19): the capacity/headroom fold is
+    sentinel-gated in BOTH directions — a hovering ~0.40 history stays
+    clean, and a collapse to 0.10 flags even though latency and
+    throughput stay flat (saturation risk surfaces before the tail
+    moves; that is the whole point of the fold)."""
+    assert _sentinel([os.path.join(FIXDIR, "headroom_clean")]) == 0
+    out = capsys.readouterr().out
+    assert "fleet_headroom_frac" in out
+    assert _sentinel([os.path.join(FIXDIR, "headroom_regressed")]) == 1
+    out = capsys.readouterr().out
+    assert "REGRESS fleet_headroom_frac" in out
+    assert "REGRESS fleet_p99_latency_ms" not in out  # tail stayed flat
+    # Skip-not-zero-fill: records without capacity stamps (pre-19
+    # fleet lines, manifests without the fold) never contribute.
+    from sav_tpu.obs.manifest import MANIFEST_SCHEMA, normalize_run_record
+
+    rec = normalize_run_record(
+        {"outcome": "ok", "fleet_p99_latency_ms": 35.0,
+         "fleet_throughput": 700.0, "fleet_headroom_frac": 0.4},
+        label="new", index=0,
+    )
+    assert rec.metrics["fleet_headroom_frac"] == 0.4
+    mrec = normalize_run_record(
+        {"schema": MANIFEST_SCHEMA, "outcome": "ok",
+         "kind": "serve_fleet", "metrics": {"fleet/headroom_frac": 0.37}},
+        label="m", index=1,
+    )
+    assert mrec.metrics["fleet_headroom_frac"] == 0.37
+    old = normalize_run_record(
+        {"outcome": "ok", "fleet_p99_latency_ms": 35.0,
+         "fleet_throughput": 700.0},
+        label="old", index=2,
+    )
+    assert "fleet_headroom_frac" not in old.metrics
 
 
 def test_sentinel_scores_router_overhead_both_directions(capsys):
@@ -1032,9 +1120,12 @@ def fleet_cache_dir(tmp_path_factory):
     return str(tmp_path_factory.mktemp("fleet_xla_cache"))
 
 
-def _run_fleet_bench(tmp_path, tag, cache_dir, extra, lockwatch=False):
+def _run_fleet_bench(tmp_path, tag, cache_dir, extra, lockwatch=False,
+                     env_extra=None):
     env = dict(os.environ)
     env["JAX_PLATFORMS"] = "cpu"
+    if env_extra:
+        env.update(env_extra)
     if lockwatch:
         # Arm the runtime lock sanitizer (ISSUE 18): the router/
         # transport/telemetry locks are tracked and the observed
@@ -1075,14 +1166,34 @@ def _run_fleet_bench(tmp_path, tag, cache_dir, extra, lockwatch=False):
 
 @pytest.mark.usefixtures("fleet_cache_dir")
 def test_fleet_smoke_two_replicas_router_shifts_load(
-    tmp_path, fleet_cache_dir
+    tmp_path, fleet_cache_dir, monkeypatch
 ):
     """The tier-1 fleet serve smoke: TWO real replica processes (fleet
     identity via the SAV_FLEET_PROC override the pool sets — the
     two_process_smoke technique), one router, +0.35s injected per-batch
     latency on rank 1. The router must shift load toward rank 0 while
     rank 1 still serves (draining/straggler pressure, not exclusion),
-    and the accounting must balance exactly."""
+    and the accounting must balance exactly.
+
+    ISSUE 19 rides the same run: an operator latency rule (via the
+    SAV_ALERT_RULES env seam) must produce EXACTLY ONE firing->resolved
+    episode on the straggler, the capacity/headroom fold must land in
+    the bench line and manifest metrics, and the ops console must
+    render from rollups alone (zero raw-stream re-parses)."""
+    rules_path = str(tmp_path / "alert_rules.json")
+    with open(rules_path, "w") as f:
+        json.dump({"rules": [{
+            # The +0.35 s injected batch delay puts rank 1's windowed
+            # p99 well over 250 ms; rank 0 stays in the tens of ms.
+            "name": "slow-replica-p99", "severity": "warn",
+            "when": [
+                {"metric": "w.p99_ms", "op": ">", "value": 250.0},
+            ],
+            # Fire on the first hot beat; resolve only via the orderly
+            # close (the injected delay never recovers in-run), so the
+            # run yields exactly one episode.
+            "for_s": 0, "resolve_s": 3600,
+        }]}, f)
     line, log_dir, manifest = _run_fleet_bench(
         tmp_path, "smoke", fleet_cache_dir,
         [
@@ -1090,6 +1201,7 @@ def test_fleet_smoke_two_replicas_router_shifts_load(
             "--deadline-ms", "4000", "--inject-delay", "1:0.35",
             "--probe-requests", "0", "--drain-timeout", "120",
         ],
+        env_extra={"SAV_ALERT_RULES": rules_path},
     )
     assert line["outcome"] == "ok"
     assert line["replicas"] == 2
@@ -1220,6 +1332,91 @@ def test_fleet_smoke_two_replicas_router_shifts_load(
     assert summary["router_live"]["completed"] == acct["completed"]
     # The manifest points at every trace artifact (run_report's hook).
     assert mdoc["notes"]["serve_traces"]["merged"] == traces["merged"]
+    # -------------- fleet metrics pipeline acceptance (ISSUE 19) ---------
+    # The straggler rule produced EXACTLY ONE firing->resolved episode,
+    # fired by the slow replica, resolved at its orderly close.
+    from sav_tpu.obs.alerts import episodes, read_alerts
+
+    events = [
+        e for e in read_alerts(log_dir) if e["rule"] == "slow-replica-p99"
+    ]
+    assert [(e["event"], e["proc"]) for e in events] == [
+        ("firing", 1), ("resolved", 1),
+    ], f"expected one firing->resolved episode on rank 1: {events}"
+    eps = episodes(read_alerts(log_dir))["slow-replica-p99"]
+    assert eps["fired"] == 1 and eps["resolved"] == 1
+    assert eps["active"] is False
+    # The episode is on the bench line and in the manifest notes.
+    assert line["alerts"]["slow-replica-p99"]["fired"] == 1
+    assert mdoc["notes"]["alerts"]["slow-replica-p99"]["fired"] == 1
+    # Capacity/headroom fold: replicas stamped measured capacity_rps,
+    # the fold summed it and projected load over the rollup series.
+    assert line["fleet_capacity_rps"] > 0
+    assert isinstance(line["fleet_headroom_frac"], float)
+    assert -1.0 <= line["fleet_headroom_frac"] <= 1.0
+    assert mdoc["metrics"]["fleet/headroom_frac"] == (
+        line["fleet_headroom_frac"]
+    )
+    assert mdoc["notes"]["fleet"]["capacity_rps"] == (
+        line["fleet_capacity_rps"]
+    )
+    assert rec.metrics["fleet_headroom_frac"] == (
+        line["fleet_headroom_frac"]
+    )
+    # The router's heartbeat thread rolled IN-RUN (cursor + tiers exist
+    # independent of the bench's post-run flush).
+    assert os.path.exists(
+        os.path.join(log_dir, "fleet", "rollup.cursor.json")
+    )
+    assert os.path.exists(
+        os.path.join(log_dir, "fleet", "rollup_10.jsonl")
+    )
+    # The ops console renders from rollups + alerts ALONE: with the raw
+    # heartbeat readers booby-trapped, gather() still renders and only
+    # the instrumented rollup reader moved.
+    import io
+
+    from sav_tpu.obs import fleet as fleet_mod
+    from sav_tpu.obs import rollup as rollup_mod
+
+    sys.path.insert(0, os.path.join(ROOT, "tools"))
+    try:
+        import fleet_console
+    finally:
+        sys.path.pop(0)
+
+    def _boom(*a, **k):
+        raise AssertionError(
+            "console re-parsed a raw heartbeat stream"
+        )
+
+    monkeypatch.setattr(fleet_mod, "read_heartbeats", _boom)
+    monkeypatch.setattr(fleet_mod, "read_router_beats", _boom)
+    reads_before = rollup_mod.READS["read_rollup"]
+    snapshot = fleet_console.gather(log_dir)
+    rendered = io.StringIO()
+    fleet_console.render(snapshot, rendered)
+    assert rollup_mod.READS["read_rollup"] > reads_before
+    assert snapshot["capacity_rps"] > 0
+    assert isinstance(snapshot["headroom_frac"], float)
+    assert set(snapshot["replicas"]) == {"0", "1"}
+    assert snapshot["alerts"]["slow-replica-p99"]["fired"] == 1
+    text = rendered.getvalue()
+    assert "capacity" in text and "headroom" in text
+    # And the user-facing CLI agrees (fresh process, --once --json).
+    console = subprocess.run(
+        [sys.executable, os.path.join(ROOT, "tools", "fleet_console.py"),
+         "--once", "--json", log_dir],
+        capture_output=True, text=True, timeout=60,
+    )
+    assert console.returncode == 0, console.stderr
+    doc = json.loads(console.stdout)
+    assert doc["headroom_frac"] == snapshot["headroom_frac"]
+    assert subprocess.run(
+        [sys.executable, os.path.join(ROOT, "tools", "fleet_console.py"),
+         "--once", str(tmp_path / "not_a_run")],
+        capture_output=True, text=True, timeout=60,
+    ).returncode == 2
 
 
 def test_fleet_chaos_sigkill_mid_flood_bounded_p99_warm_restart(

@@ -448,6 +448,76 @@ def test_serve_heartbeat_schema_and_aggregation(tmp_path):
     assert aggregate_serve(str(tmp_path / "nothing"))["replicas"] == {}
 
 
+def test_capacity_stamp_and_alert_rules_ride_the_beat(
+    tmp_path, monkeypatch
+):
+    """ISSUE 19: a replica with a measured step publishes
+    ``capacity_rps`` (max_batch / step_s_avg) in every beat, evaluates
+    the armed alert rules at beat cadence (built-in SLO rule + the
+    SAV_ALERT_RULES env seam), stamps active rule names on the line,
+    and resolves open episodes at close."""
+    from sav_tpu.obs.alerts import episodes, read_alerts
+    from sav_tpu.obs.fleet import HeartbeatWriter
+
+    rules_path = tmp_path / "rules.json"
+    rules_path.write_text(json.dumps({"rules": [{
+        "name": "hot-p99", "severity": "warn",
+        "when": [{"metric": "w.p99_ms", "op": ">", "value": 40.0}],
+    }]}))
+    monkeypatch.setenv("SAV_ALERT_RULES", str(rules_path))
+    clock = FakeClock(100.0)
+    writer = HeartbeatWriter(str(tmp_path), process_index=0, clock=clock)
+    telemetry = ServeTelemetry(
+        str(tmp_path), clock=clock, wall_clock=clock, writer=writer,
+        max_batch=8, heartbeat_secs=0.0,
+    )
+    # Armed rule set: the built-in SLO burn rule plus the env rule.
+    assert [r.name for r in telemetry.alerts.rules] == [
+        "slo-burn", "hot-p99",
+    ]
+    # A measured 20 ms step at max_batch 8 -> 400 rows/s capacity.
+    telemetry.window.observe_window(
+        latencies_s=[0.08], overruns_s=[], bucket=8, queue_depth=1,
+        step_s=0.02,
+    )
+    telemetry.serve_beat()
+    with open(tmp_path / "fleet" / "proc_0.jsonl") as f:
+        beat = json.loads(f.readline())
+    assert beat["capacity_rps"] == pytest.approx(400.0)
+    # 80 ms latency > 40 ms rule threshold: firing, stamped on the line.
+    assert beat["alerts"] == ["hot-p99"]
+    # Close resolves the open episode (the emitter outlives no episode).
+    summary = telemetry.close("ok")
+    events = read_alerts(str(tmp_path))
+    assert [(e["rule"], e["event"]) for e in events] == [
+        ("hot-p99", "firing"), ("hot-p99", "resolved"),
+    ]
+    assert episodes(events)["hot-p99"]["active"] is False
+    assert summary["alerts"]["episodes"] == {"hot-p99": 1}
+    # No writer -> no engine armed; nothing to evaluate, nothing breaks.
+    bare = ServeTelemetry(clock=FakeClock())
+    assert bare.alerts is None
+
+
+def test_capacity_absent_without_measured_step(tmp_path):
+    """Skip-not-zero-fill: no measured step (or no max_batch) means NO
+    capacity_rps key — the fold must never read an unmeasured replica
+    as zero capacity."""
+    from sav_tpu.obs.fleet import HeartbeatWriter
+
+    clock = FakeClock(10.0)
+    writer = HeartbeatWriter(str(tmp_path), process_index=0, clock=clock)
+    telemetry = ServeTelemetry(
+        str(tmp_path), clock=clock, wall_clock=clock, writer=writer,
+        max_batch=8,
+    )
+    telemetry.serve_beat()  # window empty: step_s_avg is None
+    with open(tmp_path / "fleet" / "proc_0.jsonl") as f:
+        beat = json.loads(f.readline())
+    assert "capacity_rps" not in beat
+    telemetry.close("ok")
+
+
 def test_fleet_status_renders_serve_replicas(tmp_path):
     _write_serve_beats(tmp_path, 0, [_beat(40, 20.0, 2, 100.0)])
     proc = subprocess.run(
@@ -836,7 +906,14 @@ def test_telemetry_overhead_within_two_percent(tmp_path):
     paired floods through BOTH live engines; each adjacent (on, off)
     pair yields a ratio and the best pair judges — a one-off scheduler
     hiccup slows its own pair's arm, not the verdict. A real 2%+
-    telemetry tax depresses EVERY pair and still fails."""
+    telemetry tax depresses EVERY pair and still fails. GC is paused
+    during the floods: the 100us/request gauge is cumulative
+    perf-counter accounting, and a gen-2 collection landing inside a
+    timed section bills tens of ms of interpreter housekeeping to the
+    telemetry layer (late in a full tier-1 run the heap makes that
+    routine) — the contract is the layer's own cost, not Python's."""
+    import gc
+
     from sav_tpu.serve.engine import ServeEngine
 
     n = 256
@@ -856,8 +933,10 @@ def test_telemetry_overhead_within_two_percent(tmp_path):
     rates = {"on": [], "off": []}
     for engine in engines.values():
         engine.start()
+    gc.collect()
+    gc.disable()
     try:
-        for _ in range(5):
+        for _ in range(3):
             for label, engine in engines.items():
                 t0 = time.monotonic()
                 futures = [engine.submit(img) for img in images]
@@ -872,6 +951,7 @@ def test_telemetry_overhead_within_two_percent(tmp_path):
         assert per_request <= 100e-6, stats["telemetry"]
         assert stats["telemetry"]["heartbeats"] >= 1
     finally:
+        gc.enable()
         for engine in engines.values():
             engine.stop()
     ratios = [on / off for on, off in zip(rates["on"], rates["off"])]

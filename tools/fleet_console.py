@@ -1,0 +1,309 @@
+#!/usr/bin/env python
+"""Live fleet ops console — rendered from rollups + alerts ALONE.
+
+The console is the metrics pipeline's proof of worth: every cell it
+renders (replica table, firing alerts, capacity/headroom, sparklines)
+comes from ``fleet/rollup_<res>.jsonl`` (``sav_tpu/obs/rollup.py``) and
+``fleet/alerts.jsonl`` (``sav_tpu/obs/alerts.py``). It NEVER re-parses
+the raw heartbeat streams — a week-long fleet renders in O(rollup)
+time, not O(history), and the tier-1 smoke pins that with an
+instrumented-reader check (``rollup.READS`` moves, the raw readers
+don't).
+
+By default the console only *reads*: it assumes a live roller (the
+fleet router's heartbeat thread) or a finished bench (the post-run
+flush) has populated the tiers. ``--roll`` opts into rolling in-process
+first — for rsynced dirs with no live roller. Rollups are
+single-writer: do not ``--roll`` against a dir whose router is still
+running.
+
+Stdlib-only, jax-free: safe on a laptop, safe mid-incident.
+
+Usage:
+  python tools/fleet_console.py runs/fleet            # live (ANSI, 2s)
+  python tools/fleet_console.py --once runs/fleet     # one render
+  python tools/fleet_console.py --once --json runs/fleet
+  python tools/fleet_console.py --roll --once rsynced/fleet
+
+Exit codes: 0 rendered; 2 bad dir (no ``fleet/`` layout to watch).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO_ROOT not in sys.path:  # runnable as a script from anywhere
+    sys.path.insert(0, _REPO_ROOT)
+
+# Rollup + alert readers ONLY — importing the raw-stream readers here
+# would make the zero-reparse contract a matter of discipline instead
+# of structure.
+from sav_tpu.obs.alerts import episodes, read_alerts  # noqa: E402
+from sav_tpu.obs.rollup import (  # noqa: E402
+    finest_rollup,
+    project_load,
+    series,
+)
+
+#: Projection horizon — matches the bench fold's
+#: ``sav_tpu.serve.telemetry.HEADROOM_HORIZON_S`` so the console and
+#: the manifest agree on what "projected" means.
+HORIZON_S = 60.0
+
+#: Replica-table columns: rollup metric name -> column header. Order is
+#: render order; absent metrics render as ``-`` (skip-not-zero-fill).
+REPLICA_COLUMNS = (
+    ("throughput_rps", "rps"),
+    ("p99_ms", "p99ms"),
+    ("queue_depth", "queue"),
+    ("inflight", "infl"),
+    ("capacity_rps", "cap_rps"),
+    ("burn_rate", "burn"),
+)
+
+ROUTER_COLUMNS = (
+    ("router_throughput_rps", "rps"),
+    ("router_overhead_ms", "ovh_ms"),
+    ("router_inflight", "infl"),
+    ("router_view_age_s", "view_s"),
+)
+
+_SPARK = "▁▂▃▄▅▆▇█"
+
+
+def sparkline(values, width: int = 24) -> str:
+    """Unicode sparkline of the last ``width`` values (rollup bucket
+    means). Flat series render mid-band, not empty — a steady fleet
+    still shows a pulse."""
+    vals = [float(v) for v in values if isinstance(v, (int, float))]
+    if not vals:
+        return ""
+    vals = vals[-width:]
+    lo, hi = min(vals), max(vals)
+    if hi - lo < 1e-12:
+        return _SPARK[3] * len(vals)
+    return "".join(
+        _SPARK[int((v - lo) / (hi - lo) * (len(_SPARK) - 1))] for v in vals
+    )
+
+
+def _latest(lines: list) -> dict:
+    """Newest closed bucket per ``(proc, metric)`` — the replica
+    table's cells. ``{proc: {metric: {"mean","p99","bucket",...}}}``."""
+    out: dict = {}
+    for line in lines:  # read_rollup returns bucket-sorted lines
+        proc = line.get("proc")
+        metric = line.get("metric")
+        if proc is None or not metric:
+            continue
+        row = out.setdefault(proc, {})
+        prev = row.get(metric)
+        if prev is None or line["bucket"] >= prev["bucket"]:
+            row[metric] = line
+    return out
+
+
+def gather(log_dir: str) -> dict:
+    """One console snapshot, from rollups + alerts only."""
+    res, lines = finest_rollup(log_dir)
+    latest = _latest(lines)
+    replicas = {
+        proc: row for proc, row in latest.items() if proc != "router"
+    }
+    capacity = [
+        row["capacity_rps"]["mean"]
+        for row in replicas.values()
+        if "capacity_rps" in row
+    ]
+    load_points = series(lines, "throughput_rps")
+    projection = project_load(load_points, horizon_s=HORIZON_S)
+    capacity_rps = round(sum(capacity), 2) if capacity else None
+    headroom = None
+    if capacity_rps and projection is not None:
+        raw = (capacity_rps - projection["projected_rps"]) / capacity_rps
+        headroom = round(max(min(raw, 1.0), -1.0), 4)
+    spark = {
+        "fleet_rps": [v for _, v in load_points],
+        "replica_p99_ms": {
+            str(proc): [
+                v for _, v in series(lines, "p99_ms", proc=proc)
+            ]
+            for proc in replicas
+        },
+    }
+    return {
+        "log_dir": log_dir,
+        "res": res,
+        "rollup_lines": len(lines),
+        "replicas": {
+            str(proc): {
+                metric: {
+                    "bucket": cell["bucket"],
+                    "mean": cell["mean"],
+                    "p99": cell["p99"],
+                }
+                for metric, cell in row.items()
+            }
+            for proc, row in sorted(replicas.items(), key=lambda kv: str(kv[0]))
+        },
+        "router": {
+            metric: {"bucket": cell["bucket"], "mean": cell["mean"]}
+            for metric, cell in (latest.get("router") or {}).items()
+        },
+        "capacity_rps": capacity_rps,
+        "projection": projection,
+        "headroom_frac": headroom,
+        "alerts": episodes(read_alerts(log_dir)),
+        "spark": spark,
+    }
+
+
+def _cell(row: dict, metric: str) -> str:
+    cell = row.get(metric)
+    if not cell or not isinstance(cell.get("mean"), (int, float)):
+        return "-"
+    return f"{cell['mean']:.1f}"
+
+
+def render(snapshot: dict, out) -> None:
+    res = snapshot.get("res")
+    print(
+        f"== Fleet console: {snapshot['log_dir']} "
+        f"(rollup res {res}s, {snapshot['rollup_lines']} lines) ==",
+        file=out,
+    )
+    if res is None:
+        print(
+            "(no rollups yet — live runs roll at heartbeat cadence; "
+            "for rsynced dirs pass --roll)",
+            file=out,
+        )
+        return
+    replicas = snapshot.get("replicas") or {}
+    if replicas:
+        headers = [h for _, h in REPLICA_COLUMNS]
+        print(
+            "  proc  " + "".join(f"{h:>9}" for h in headers) + "  p99 trend",
+            file=out,
+        )
+        for proc, row in replicas.items():
+            cells = "".join(
+                f"{_cell(row, metric):>9}" for metric, _ in REPLICA_COLUMNS
+            )
+            trend = sparkline(
+                (snapshot["spark"]["replica_p99_ms"] or {}).get(proc) or []
+            )
+            print(f"  {proc:>4}  {cells}  {trend}", file=out)
+    router = snapshot.get("router") or {}
+    if router:
+        cells = "  ".join(
+            f"{header} {router[metric]['mean']:.1f}"
+            for metric, header in ROUTER_COLUMNS
+            if isinstance((router.get(metric) or {}).get("mean"), (int, float))
+        )
+        print(f"  router: {cells}", file=out)
+    cap = snapshot.get("capacity_rps")
+    proj = snapshot.get("projection")
+    head = snapshot.get("headroom_frac")
+    if cap is not None:
+        line = f"  capacity {cap:.1f} rps"
+        if proj is not None:
+            line += (
+                f" | load {proj['now_rps']:.1f} rps"
+                f" -> {proj['projected_rps']:.1f} in {proj['horizon_s']:.0f}s"
+            )
+        if head is not None:
+            line += f" | headroom {head * 100:.1f}%"
+        print(line, file=out)
+    if snapshot["spark"]["fleet_rps"]:
+        print(
+            f"  fleet rps {sparkline(snapshot['spark']['fleet_rps'])}",
+            file=out,
+        )
+    alerts = snapshot.get("alerts") or {}
+    firing = {r: e for r, e in alerts.items() if e.get("active")}
+    if firing:
+        for rule, entry in sorted(firing.items()):
+            print(
+                f"  ALERT [{entry.get('severity')}] {rule} firing "
+                f"(episode {entry.get('fired')})",
+                file=out,
+            )
+    elif alerts:
+        done = ", ".join(
+            f"{rule} x{entry.get('fired')}" for rule, entry in sorted(alerts.items())
+        )
+        print(f"  alerts: none firing (resolved: {done})", file=out)
+    else:
+        print("  alerts: none", file=out)
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter,
+    )
+    parser.add_argument("log_dir", help="run directory (contains fleet/)")
+    parser.add_argument(
+        "--once", action="store_true", help="render once and exit"
+    )
+    parser.add_argument(
+        "--json", action="store_true",
+        help="emit the snapshot as JSON (implies --once)",
+    )
+    parser.add_argument(
+        "--interval", type=float, default=2.0,
+        help="live refresh seconds (default 2.0)",
+    )
+    parser.add_argument(
+        "--roll", action="store_true",
+        help="roll new bytes in-process before rendering (offline dirs "
+        "only — rollups are single-writer)",
+    )
+    args = parser.parse_args(argv)
+    fleet = os.path.join(args.log_dir, "fleet")
+    if not os.path.isdir(fleet):
+        print(
+            f"fleet_console: no fleet/ under {args.log_dir!r} — nothing "
+            "to watch",
+            file=sys.stderr,
+        )
+        return 2
+
+    def refresh() -> dict:
+        if args.roll:
+            from sav_tpu.obs.rollup import Roller
+
+            try:
+                roller = Roller(args.log_dir)
+                roller.roll_once()
+                roller.flush()
+            except Exception:  # noqa: BLE001 — render what's readable
+                pass
+        return gather(args.log_dir)
+
+    if args.json:
+        print(json.dumps(refresh(), indent=2, sort_keys=True))
+        return 0
+    if args.once:
+        render(refresh(), sys.stdout)
+        return 0
+    try:
+        while True:
+            snapshot = refresh()
+            # ANSI: clear screen + home, then one full frame.
+            sys.stdout.write("\x1b[2J\x1b[H")
+            render(snapshot, sys.stdout)
+            sys.stdout.flush()
+            time.sleep(max(args.interval, 0.2))
+    except KeyboardInterrupt:
+        return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

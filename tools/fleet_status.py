@@ -181,6 +181,31 @@ def render(log_dir: str, summary: dict, out) -> None:
                 + f", shed {v.get('shed')}{flame}",
                 file=out,
             )
+        # Capacity/headroom fold (ISSUE 19): summed measured
+        # capacity_rps stamps vs the Theil-Sen load projection.
+        if fleet_line.get("capacity_rps") is not None:
+            head = fleet_line.get("headroom_frac")
+            print(
+                f"  capacity {fleet_line['capacity_rps']} req/s"
+                + (
+                    f", projected load {fleet_line['projected_rps']} req/s"
+                    if fleet_line.get("projected_rps") is not None else ""
+                )
+                + (f", headroom {head:.1%}" if head is not None else ""),
+                file=out,
+            )
+    # Alert episodes (ISSUE 19): the declarative rule engine's
+    # fleet/alerts.jsonl stream folded to per-rule accounting.
+    from sav_tpu.obs.alerts import episodes, read_alerts
+
+    for rule, entry in sorted(episodes(read_alerts(log_dir)).items()):
+        state = "FIRING" if entry.get("active") else "resolved"
+        print(
+            f"  alert {rule} [{entry.get('severity')}]: {state}, "
+            f"{entry.get('fired')} episode(s), last at "
+            f"{_fmt_unix(entry.get('last_t'))}",
+            file=out,
+        )
     # kind=router heartbeat stream (ISSUE 16): the fleet router is a
     # first-class fleet citizen — its live windowed view renders next
     # to the replicas it balances (full detail: tools/serve_status.py).

@@ -162,6 +162,16 @@ METRICS = {
     # 0.1 ms/request, so sub-50µs jitter on a flat history is
     # scheduler noise, not a regression.
     "router_overhead_ms": (False, 0.05),
+    # Fleet headroom fraction ((capacity - projected load) / capacity,
+    # ISSUE 19 — the capacity/headroom fold over the rollup ladder,
+    # docs/fleet.md). Higher is better: a drop with flat latency means
+    # measured capacity shrank (slower steps, a lost replica's stamps)
+    # or projected load grew — the fleet is closer to saturation than
+    # the tail metrics show yet. Present only on fleet records whose
+    # replicas stamped capacity_rps; older records are skipped, not
+    # zero-filled. Absolute floor 0.02 (two points of headroom):
+    # projection noise on a flat history is not a regression.
+    "fleet_headroom_frac": (True, 0.02),
 }
 
 EXIT_CLEAN, EXIT_REGRESSION, EXIT_USAGE = 0, 1, 2

@@ -658,6 +658,30 @@ def report_serve(log_dir: str, out, manifests: list = None) -> None:
             "an r11+ engine with telemetry on)",
             file=out,
         )
+    # Capacity/headroom + alert episodes (ISSUE 19): the fleet fold
+    # carries summed capacity_rps stamps vs the load projection, and
+    # fleet/alerts.jsonl carries the declarative rule engine's events.
+    fleet_fold = serve.get("fleet") or {}
+    if fleet_fold.get("capacity_rps") is not None:
+        head = fleet_fold.get("headroom_frac")
+        print(
+            f"  capacity {fleet_fold['capacity_rps']} req/s"
+            + (
+                f", projected load {fleet_fold['projected_rps']} req/s"
+                if fleet_fold.get("projected_rps") is not None else ""
+            )
+            + (f", headroom {head:.1%}" if head is not None else ""),
+            file=out,
+        )
+    from sav_tpu.obs.alerts import episodes as _alert_eps, read_alerts
+
+    for rule, entry in sorted(_alert_eps(read_alerts(log_dir)).items()):
+        state = "FIRING" if entry.get("active") else "resolved"
+        print(
+            f"  alert {rule} [{entry.get('severity')}]: {state}, "
+            f"{entry.get('fired')} episode(s)",
+            file=out,
+        )
     if exemplars:
         print(
             f"  slow-request exemplars: {len(exemplars)} "

@@ -390,6 +390,24 @@ def run_fleet(args, manifest) -> dict:
         str(rank): ((doc.get("startup") or {}).get("compiled_from_scratch"))
         for rank, doc in sorted(endpoints.items())
     }
+    # Fleet metrics pipeline (ISSUE 19): the router's heartbeat thread
+    # rolled the streams in-run; one final roll + flush here folds the
+    # tail beats (router is stopped — single-writer cursor is free), so
+    # the capacity/headroom fold and the ops console read the whole run
+    # from rollups alone.
+    from sav_tpu.obs.alerts import episodes as alert_episodes
+    from sav_tpu.obs.alerts import read_alerts
+    from sav_tpu.obs.rollup import Roller
+    from sav_tpu.serve.telemetry import aggregate_serve
+
+    try:
+        roller = Roller(log_dir)
+        roller.roll_once()
+        roller.flush()
+    except Exception:  # noqa: BLE001 — rollups are best-effort
+        pass
+    fleet_fold = (aggregate_serve(log_dir) or {}).get("fleet") or {}
+    alert_eps = alert_episodes(read_alerts(log_dir))
     latency = summary.get("latency_ms") or {}
     # Client-side ledger: every offered request resolved as exactly one
     # of completed / shed (admission reject OR deadline shed on the
@@ -435,6 +453,8 @@ def run_fleet(args, manifest) -> dict:
         "fleet_p95_latency_ms": latency.get("p95"),
         "fleet_p99_latency_ms": latency.get("p99"),
         "fleet_throughput": summary.get("throughput_rps"),
+        "fleet_capacity_rps": fleet_fold.get("capacity_rps"),
+        "fleet_headroom_frac": fleet_fold.get("headroom_frac"),
         "fleet_shed": shed_total,
         "accounting": accounting,
         "rerouted": summary["rerouted"],
@@ -468,6 +488,10 @@ def run_fleet(args, manifest) -> dict:
         metrics["fleet/router_overhead_ms"] = float(
             summary["router_overhead_ms"]
         )
+    # Headroom is skip-not-zero-fill too: absent capacity stamps (old
+    # replicas, zero-request runs) must not read as "no headroom".
+    if isinstance(fleet_fold.get("headroom_frac"), (int, float)):
+        metrics["fleet/headroom_frac"] = float(fleet_fold["headroom_frac"])
     manifest.note("metric", out["metric"])
     if platform:
         manifest.note("platform", platform)
@@ -476,7 +500,13 @@ def run_fleet(args, manifest) -> dict:
         "accounting": accounting,
         "chaos": chaos,
         "probe_routed": probe_routed,
+        "capacity_rps": fleet_fold.get("capacity_rps"),
+        "projected_rps": fleet_fold.get("projected_rps"),
+        "headroom_frac": fleet_fold.get("headroom_frac"),
     })
+    if alert_eps:
+        out["alerts"] = alert_eps
+        manifest.note("alerts", alert_eps)
     manifest.note("serve_traces", serve_traces)
     manifest.finalize(
         outcome,

@@ -76,6 +76,12 @@ def gather(log_dir: str) -> dict:
     beats = read_router_beats(log_dir, tail_bytes=262_144)
     summary["router_beats"] = len(beats)
     summary["router_live"] = beats[-1] if beats else None
+    # Alert episodes (ISSUE 19): the declarative rule engine's event
+    # stream, folded to per-rule accounting. tools/fleet_console.py is
+    # the live view; this is the post-mortem one.
+    from sav_tpu.obs.alerts import episodes, read_alerts
+
+    summary["alerts"] = episodes(read_alerts(log_dir))
     return summary
 
 
@@ -130,6 +136,27 @@ def render(log_dir: str, summary: dict, out) -> None:
                 f", BURNING replicas {fleet['burning']}"
                 if fleet.get("burning") else ""
             ),
+            file=out,
+        )
+    # Capacity/headroom fold (ISSUE 19) — present only when replicas
+    # stamped measured capacity_rps.
+    if fleet.get("capacity_rps") is not None:
+        head = fleet.get("headroom_frac")
+        print(
+            f"Capacity: {fleet['capacity_rps']} req/s"
+            + (
+                f", projected load {fleet['projected_rps']} req/s"
+                if fleet.get("projected_rps") is not None else ""
+            )
+            + (f", headroom {head:.1%}" if head is not None else ""),
+            file=out,
+        )
+    for rule, entry in sorted((summary.get("alerts") or {}).items()):
+        state = "FIRING" if entry.get("active") else "resolved"
+        print(
+            f"alert {rule} [{entry.get('severity')}]: {state}, "
+            f"{entry.get('fired')} episode(s), last at "
+            f"{_fmt_unix(entry.get('last_t'))}",
             file=out,
         )
     suspects = summary.get("suspects") or []

@@ -1586,6 +1586,120 @@ class AlertEvalInHotPath(Rule):
                     )
 
 
+# ---------------------------------------------------------------- SAV126
+
+
+class QualityEvalInHotPath(Rule):
+    """Prediction-quality evaluation inside request hot paths.
+
+    The quality layer's contract (sav_tpu/serve/quality.py,
+    sav_tpu/obs/quality.py, docs/quality.md) is that measuring
+    prediction quality adds ZERO device syncs and zero per-request
+    eval to the serving path: the output digests are traced INTO the
+    serving executable and ride the device loop's one sanctioned
+    result fetch; the windowed folds/drift gates run on values that
+    are already host-side; probes run on their own low-cadence thread;
+    shadow scoring runs on the router's dedicated shadow worker (the
+    dispatch path only does an O(1) bounded queue put). Two ways an
+    edit silently breaks that, and this rule owns both:
+
+    1. A device sync slipped into the quality fold functions
+       themselves (``observe_digests`` / ``score_shadow`` /
+       ``quality_snapshot`` / ``observe_probe`` — outside every other
+       sync rule's scope, so SAV126 audits them with the shared
+       ``_metrics_sync_findings`` catalogue). ``observe_probe`` may
+       block on request FUTURES by design — it never runs on the hot
+       path — but a raw ``device_get``/``.item()`` there would still
+       be a smell the catalogue rightly flags.
+    2. A quality evaluation called FROM a request hot path — a
+       ``sav_tpu.{obs,serve}.quality`` call, or a
+       snapshot/score/digest method on a quality/probe/shadow/scorer
+       object, inside the batcher submit path, the per-batch telemetry
+       stamps, or the router admission/dispatch surface. Windowed
+       churn/PSI folds and logit comparisons are O(window·classes)
+       host math: cheap at heartbeat cadence, poison at request rate.
+       The scope deliberately overlaps SAV125's hot-path set (same
+       functions) but reports DIFFERENT calls (quality evals, not
+       alert/rollup writes), so nothing double-reports. The engine's
+       ``_complete`` is deliberately NOT in scope: its
+       ``observe_digests`` fold on the already-fetched host digests is
+       the sanctioned per-batch fold, like the latency ledger's.
+    """
+
+    id = "SAV126"
+    name = "quality-eval-in-hot-path"
+    severity = "error"
+    hint = (
+        "quality folds belong off the request path: digests ride the "
+        "device loop's existing fetch, probes run on the probe thread, "
+        "shadow scoring on the shadow worker, snapshots at heartbeat "
+        "cadence (serve_beat/_quality_tick); if a hot-path evaluation "
+        "is truly intentional, pragma it with a justification"
+    )
+
+    # The quality layer's own surface: audited host-only by the shared
+    # sync catalogue. Disjoint from SAV111/SAV112/SAV115/SAV116/
+    # SAV118/SAV119's sets — overlapping scopes would double-report.
+    QUALITY_FUNCTIONS = frozenset({
+        "observe_digests", "observe_probe", "score_shadow",
+        "quality_snapshot",
+    })
+
+    # The request hot paths (SAV125's set — same paths, different
+    # calls). _complete and the heartbeat/shadow-worker homes are
+    # deliberately absent.
+    FUNCTIONS = AlertEvalInHotPath.FUNCTIONS
+
+    _EVAL_METHODS = frozenset({
+        "observe_digests", "observe_probe", "score_shadow",
+        "quality_snapshot", "snapshot", "score",
+    })
+    _QUALITY_ROOTS = ("quality", "probe", "shadow", "scorer")
+
+    def check(self, module):
+        for fn in module.functions:
+            if fn.name in self.QUALITY_FUNCTIONS:
+                yield from _metrics_sync_findings(
+                    self, module, fn,
+                    where="quality fold",
+                    coda="digests ride the device loop's existing fetch",
+                )
+            if fn.name not in self.FUNCTIONS:
+                continue
+            for node in _walk_excluding_nested(fn):
+                if not isinstance(node, ast.Call):
+                    continue
+                resolved = module.resolve_call(node) or ""
+                if resolved.startswith(
+                    ("sav_tpu.obs.quality.", "sav_tpu.serve.quality.")
+                ):
+                    yield _finding(
+                        self,
+                        node,
+                        f"{resolved}() in request hot path {fn.name}() — "
+                        "quality evaluation runs at heartbeat/probe "
+                        "cadence, not per request",
+                    )
+                    continue
+                if not isinstance(node.func, ast.Attribute):
+                    continue
+                chain = _attr_chain(node.func)
+                attr = node.func.attr
+                if attr in self._EVAL_METHODS and any(
+                    root in part
+                    for part in chain[:-1]
+                    for root in self._QUALITY_ROOTS
+                ):
+                    yield _finding(
+                        self,
+                        node,
+                        f"quality evaluation (.{attr}() on "
+                        f"{'.'.join(chain[:-1])}) in request hot path "
+                        f"{fn.name}() — fold/score off the request path "
+                        "(heartbeat, probe thread, or shadow worker)",
+                    )
+
+
 # ----------------------------------------------------------- SAV100 (meta)
 
 
@@ -1658,6 +1772,7 @@ ALL_RULES = [
     RouterTraceHotPathSync(),
     UnscaledInt8Cast(),
     AlertEvalInHotPath(),
+    QualityEvalInHotPath(),
 ]
 
 # The whole-program concurrency pass (SAV121–SAV124) lives in its own

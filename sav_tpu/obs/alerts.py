@@ -197,6 +197,54 @@ def default_rules(slo_burn_threshold: float = 2.0) -> list:
     return [slo_burn_rule(slo_burn_threshold)]
 
 
+def quality_rules() -> list:
+    """The prediction-quality rule set (ISSUE 20, docs/quality.md) —
+    a SEPARATE set from :func:`default_rules` on purpose: the
+    default-rules contract ("exactly the SLO rule") is pinned by
+    tests/test_alerts.py, and quality rules arm alongside it, not
+    inside it.
+
+    The two integrity rules gate on CUMULATIVE MONOTONIC counters
+    (``quality.probe_mismatch``, ``shadow.breach``) with ``for_s=0``
+    and a long ``resolve_s``: a planted fault fires exactly one
+    episode that resolves only at finalize — the exactly-once shape
+    the straggler battery pins for latency alerts. The two drift
+    rules (churn / entropy shift) gate on windowed statistics and
+    debounce with for/resolve holds instead. Records without quality
+    fields (training beats, pre-reference windows) evaluate False —
+    missing metrics never fire."""
+    return [
+        AlertRule(
+            "quality-churn",
+            when=[("quality.churn", ">", 0.5)],
+            severity="warn",
+            for_s=10.0,
+            resolve_s=30.0,
+        ),
+        AlertRule(
+            "quality-entropy-shift",
+            when=[("quality.entropy_shift", ">", 6.0)],
+            severity="warn",
+            for_s=10.0,
+            resolve_s=30.0,
+        ),
+        AlertRule(
+            "quality-probe-mismatch",
+            when=[("quality.probe_mismatch", ">", 0.0)],
+            severity="page",
+            for_s=0.0,
+            resolve_s=3600.0,
+        ),
+        AlertRule(
+            "shadow-agreement",
+            when=[("shadow.breach", ">", 0.0)],
+            severity="page",
+            for_s=0.0,
+            resolve_s=3600.0,
+        ),
+    ]
+
+
 def load_rules(source) -> list:
     """Rules from a JSON file path, a JSON string, or a parsed doc
     (``{"rules": [...]}`` or a bare list). Raises ValueError on

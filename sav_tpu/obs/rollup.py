@@ -139,6 +139,24 @@ def metrics_from(record: dict) -> dict:
             burn = slo.get("burn_rate")
             if isinstance(burn, (int, float)):
                 out["burn_rate"] = float(burn)
+        # Prediction-quality snapshots roll under distinct prefixes so
+        # the console/alert fold reads them from rollups alone
+        # (docs/quality.md). Nested dicts (per-pair stats) stay in the
+        # raw beats — rollups carry only the scalar headline.
+        quality = record.get("quality")
+        if kind == "serve" and isinstance(quality, dict):
+            for key, value in quality.items():
+                if isinstance(value, (int, float)) and not isinstance(
+                    value, bool
+                ):
+                    out["quality_" + key] = float(value)
+        shadow = record.get("shadow")
+        if kind == "router" and isinstance(shadow, dict):
+            for key, value in shadow.items():
+                if isinstance(value, (int, float)) and not isinstance(
+                    value, bool
+                ):
+                    out["router_shadow_" + key] = float(value)
     elif kind == "hb":
         for key in _HB_KEYS:
             value = record.get(key)

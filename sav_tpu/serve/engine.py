@@ -128,6 +128,13 @@ class ServeConfig:
     # Serve heartbeat cadence (kind=serve lines in fleet/proc_<i>.jsonl;
     # 0 disables the thread).
     heartbeat_secs: float = 5.0
+    # Golden-probe cadence (sav_tpu/serve/quality.py; docs/quality.md):
+    # every probe_every_s seconds an idle engine runs the checked-in
+    # probe batch through the normal admission path and fingerprints
+    # the logits. 0 disables the probe thread. Probes shed themselves
+    # whenever live work is queued or in flight — they never evict a
+    # live request.
+    probe_every_s: float = 0.0
     # Completed request traces kept in the span ring.
     trace_ring: int = 256
     # Slow-request exemplar bundles dumped per run (serve_traces/).
@@ -367,12 +374,31 @@ class ServeEngine:
         self._params, self._batch_stats, params_source = self._load_params(
             params, batch_stats
         )
+        noise_scale = os.environ.get("SAV_CHAOS_NOISE_WEIGHTS")
+        if noise_scale:
+            # Chaos seam (docs/quality.md "Chaos"): deterministically
+            # corrupt the FLOAT tree before any quantization, so a
+            # planted-fault replica misbehaves identically on every
+            # arm — the shadow-agreement / probe-mismatch detection
+            # tests and the r20 battery plant faults through this.
+            from sav_tpu.serve.quality import noise_params
+
+            self._params = noise_params(self._params, float(noise_scale))
         self._quant_report: Optional[dict] = None
         if config.quant_weights:
             self._params, self._quant_report = self._quantize_params_tree(
                 self._params
             )
-        self._infer = jax.jit(build_infer_fn(model, self.compute_dtype))
+        # The serving program additionally returns per-row output
+        # digests (top-1 / margin / entropy) computed in-graph — they
+        # ride the existing result fetch, so quality telemetry costs
+        # zero extra device syncs on the request path (SAV126;
+        # docs/quality.md).
+        from sav_tpu.serve.quality import digested_infer_fn
+
+        self._infer = jax.jit(
+            digested_infer_fn(build_infer_fn(model, self.compute_dtype))
+        )
         # ---- AOT: one executable per bucket, warmed from the cache ----
         compile_t0 = time.perf_counter()
         cache_pre_aot = _count_cache_entries(config.compilation_cache_dir)
@@ -497,6 +523,17 @@ class ServeEngine:
                 self.manifest.note(
                     "quant", dict(self._quant_report, weights="int8")
                 )
+        # ---- quality: digest windows + golden-probe ledger -------------
+        # Always constructed (the digests ride every executable), even
+        # without telemetry — tests and embedders can read
+        # quality_snapshot() directly. Stdlib-side folds only
+        # (sav_tpu/obs/quality.py); the probe thread spins up in
+        # start() when probe_every_s > 0.
+        from sav_tpu.obs.quality import ProbeLedger, QualityTracker
+
+        self._quality = QualityTracker()
+        self._probe_ledger = ProbeLedger()
+        self._probe = None
         # ---- telemetry: spans + live windows + heartbeats + SLO --------
         self._telemetry: Optional[ServeTelemetry] = None
         self._watermark = None
@@ -556,6 +593,10 @@ class ServeEngine:
                     self._batcher.stats() if self._batcher else {}
                 ),
                 hbm_fn=_hbm,
+                # Quality fields on every kind=serve beat (ISSUE 20):
+                # digest drift gates + probe fingerprint state, folded
+                # at beat cadence — never per request.
+                quality_fn=self.quality_snapshot,
                 # Measured capacity stamp (ISSUE 19): the ladder's top
                 # rung over the windowed step — beats publish
                 # capacity_rps, the fleet fold sums it into headroom.
@@ -745,6 +786,15 @@ class ServeEngine:
         if self._telemetry is not None:
             self._telemetry.start()
         self._device_thread.start()
+        if self.config.probe_every_s > 0:
+            from sav_tpu.serve.quality import ProbeRunner
+
+            self._probe = ProbeRunner(
+                self,
+                self._probe_ledger,
+                every_s=self.config.probe_every_s,
+                log_dir=self.config.log_dir,
+            ).start()
         return self
 
     def _estimate_step(self, bucket: int) -> float:
@@ -818,7 +868,11 @@ class ServeEngine:
                     out = self._executables[formed.bucket](
                         self._params, self._batch_stats, placed
                     )
-                    host = np.asarray(out)
+                    # One fetch for the whole output tree: the logits
+                    # plus the in-graph digest leaves land in the same
+                    # transfer the logits alone used to (SAV126's
+                    # zero-extra-syncs contract).
+                    host = jax.device_get(out)
                     if self._telemetry is not None:
                         t_exec = self._telemetry.clock()
                         for request in formed.requests:
@@ -838,10 +892,11 @@ class ServeEngine:
             if self._batcher is not None:
                 self._batcher.close()
 
-    def _complete(self, formed: FormedBatch, logits: np.ndarray, t0: float):
+    def _complete(self, formed: FormedBatch, host: dict, t0: float):
         self._batcher.mark_completed()
         done_t = time.perf_counter()
         step_s = done_t - t0
+        logits = host["logits"]
         # EMA keeps the batcher's dispatch-by estimate tracking the
         # hardware (warmup seeds it; single writer: this thread).
         prev = self._step_est.get(formed.bucket, step_s)
@@ -857,6 +912,16 @@ class ServeEngine:
                 stamp(request.trace, "completed", telemetry.clock())
             latencies.append(now - request.enqueue_t)
             overruns.append(now - request.deadline_t)
+        n = len(formed.requests)
+        # Digest rows into the quality window: host values, bounded
+        # deque appends only — the gate math waits for the beat thread
+        # (obs/quality.py; SAV126).
+        self._quality.observe_digests(
+            host["top1"][:n].tolist(),
+            host["margin"][:n].tolist(),
+            host["entropy"][:n].tolist(),
+            num_classes=self.config.num_classes,
+        )
         self.ledger.observe_batch(
             bucket=formed.bucket,
             latencies_s=latencies,
@@ -977,6 +1042,13 @@ class ServeEngine:
         if self._stopped:
             return self.ledger.summary()
         self._stopped = True
+        if self._probe is not None:
+            # Before the batcher closes: the probe thread must not be
+            # mid-submit when admission shuts, and its ledger state must
+            # be final before telemetry's close() emits the final
+            # quality beat (the leave-the-failing-fingerprint-on-disk
+            # contract, docs/quality.md).
+            self._probe.close()
         if self._batcher is not None:
             self._batcher.close()
         if self._device_thread is not None:
@@ -1037,6 +1109,18 @@ class ServeEngine:
                     self.manifest.note(
                         "alerts", tele_summary["alerts"]
                     )
+            qsnap = self.quality_snapshot()
+            if qsnap.get("n") or qsnap.get("probe_runs"):
+                # notes.quality + the sentinel-facing probe metric:
+                # "what did this run predict and did the probe hold"
+                # reads from the manifest alone. probe_ok_frac is
+                # absent when no probe ran — skipped, never
+                # zero-filled (the attention_core_frac contract).
+                self.manifest.note("quality", qsnap)
+                if isinstance(qsnap.get("probe_ok_frac"), (int, float)):
+                    metrics["serve/probe_ok_frac"] = float(
+                        qsnap["probe_ok_frac"]
+                    )
             if (
                 self._watermark is not None
                 and self._watermark.source is not None
@@ -1056,8 +1140,20 @@ class ServeEngine:
         self.stop(error=exc)
         return False
 
+    def quality_snapshot(self) -> dict:
+        """The quality fields one heartbeat (and the manifest's
+        ``notes.quality``) carries: digest drift gates + probe ledger
+        state. Host bookkeeping only — named for savlint SAV126's
+        audit set, which proves no device sync ever hides in here."""
+        out = self._quality.snapshot()
+        out.update(self._probe_ledger.snapshot())
+        return out
+
     def stats(self) -> dict:
         out = {"ledger": self.ledger.summary(), "errors": self._errors}
+        qsnap = self.quality_snapshot()
+        if qsnap.get("n") or qsnap.get("probe_runs"):
+            out["quality"] = qsnap
         if self._batcher is not None:
             out["batcher"] = self._batcher.stats()
         if self._feeder is not None:

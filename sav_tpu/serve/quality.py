@@ -1,0 +1,280 @@
+"""Device-side prediction-quality primitives: in-graph output digests,
+the golden-probe batch + fingerprint, and the deterministic
+weight-perturbation chaos seam.
+
+The scalar folds (windows, drift gates, ledgers) live stdlib-side in
+``sav_tpu.obs.quality`` — this module is the only quality code allowed
+to touch jax/numpy, and none of it runs on the request hot path:
+
+- :func:`output_digests` is TRACED into the serving executable — the
+  digests ride the batch's existing result fetch as three extra tiny
+  output leaves (B ints + 2B floats), so quality telemetry adds zero
+  device syncs to the request path (savlint SAV126).
+- :class:`ProbeRunner` runs on its own low-cadence thread and submits
+  through the NORMAL admission path, but only when the engine is fully
+  idle — a probe sheds itself before it would ever queue behind (or
+  evict) a live request.
+- :func:`fingerprint_logits` is a blake2b over the exact float32 logit
+  bytes: bit-stable under a fixed executable, so a matching fingerprint
+  across a restart/swap proves weight integrity (and a per-dtype
+  reference keeps int8 and bf16 replicas from judging each other's
+  bits). This is the determinism primitive ROADMAP item 5's promotion
+  cache needs.
+
+See docs/quality.md.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import threading
+from typing import Callable, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+# Golden probe shape: small on purpose (one bucket-1..4 batch); the
+# probe is a weight-integrity check, not a benchmark.
+PROBE_ROWS = 4
+_PROBE_TAG = b"sav_tpu golden probe v1"
+
+
+def output_digests(logits, valid):
+    """Per-row digest leaves, computed IN-GRAPH next to the logits:
+    top-1 class index, top-1 margin (best minus runner-up), and
+    predictive entropy (nats). Padded rows are masked to zero by the
+    same validity mask that already zeroes their logits."""
+    top1 = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    # Runner-up via a masked second reduce, not lax.top_k: top_k
+    # lowers to a sort and this subgraph is re-compiled into EVERY
+    # bucket executable of every engine — two max-reduces keep the
+    # per-bucket compile cost flat. Masking exactly the argmax slot
+    # (not every tied maximum) preserves top_k's tie semantics: all
+    # logits equal gives margin 0, never -inf.
+    num_classes = logits.shape[-1]
+    best = jnp.max(logits, axis=-1)
+    is_top1 = (
+        jax.lax.broadcasted_iota(jnp.int32, logits.shape, len(logits.shape) - 1)
+        == top1[..., None]
+    )
+    second = jnp.max(
+        jnp.where(is_top1, jnp.finfo(logits.dtype).min, logits), axis=-1
+    )
+    if num_classes < 2:  # degenerate single-class head: no runner-up
+        second = best
+    margin = (best - second) * valid
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    entropy = -jnp.sum(jnp.exp(logp) * logp, axis=-1) * valid
+    return {
+        "top1": (top1 * valid.astype(jnp.int32)),
+        "margin": margin.astype(jnp.float32),
+        "entropy": entropy.astype(jnp.float32),
+    }
+
+
+def digested_infer_fn(infer_fn: Callable) -> Callable:
+    """Wrap a ``build_infer_fn`` program so the compiled executable
+    returns ``{"logits", "top1", "margin", "entropy"}`` — the digests
+    are folded into the same program (and the same single result fetch)
+    rather than computed host-side per request."""
+
+    def infer(params, batch_stats, batch):
+        logits = infer_fn(params, batch_stats, batch)
+        out = {"logits": logits}
+        out.update(output_digests(logits, batch["valid"]))
+        return out
+
+    return infer
+
+
+# --------------------------------------------------------------- probe
+
+
+def make_probe_batch(image_size: int, rows: int = PROBE_ROWS) -> tuple:
+    """(images, probe_id): a content-addressed deterministic uint8 probe
+    batch. The bytes are a blake2b stream keyed only by the request
+    shape, so every replica of every fleet regenerates the identical
+    batch — and ``probe_id`` (the digest OF those bytes) names it, so a
+    reference fingerprint can never be compared against logits from a
+    different probe."""
+    need = rows * image_size * image_size * 3
+    chunks = []
+    counter = 0
+    while sum(len(c) for c in chunks) < need:
+        h = hashlib.blake2b(
+            _PROBE_TAG + f":{image_size}:{rows}:{counter}".encode(),
+            digest_size=64,
+        )
+        chunks.append(h.digest())
+        counter += 1
+    raw = b"".join(chunks)[:need]
+    images = np.frombuffer(raw, np.uint8).reshape(
+        rows, image_size, image_size, 3
+    )
+    probe_id = hashlib.blake2b(raw, digest_size=8).hexdigest()
+    return images, probe_id
+
+
+def fingerprint_logits(rows) -> str:
+    """blake2b over the exact float32 logit bytes of the probe rows —
+    bit-stable under a fixed executable + weights."""
+    h = hashlib.blake2b(digest_size=16)
+    for row in rows:
+        h.update(np.ascontiguousarray(np.asarray(row, np.float32)).tobytes())
+    return h.hexdigest()
+
+
+def _reference_path(log_dir: str) -> str:
+    return os.path.join(log_dir, "fleet", "probe_reference.json")
+
+
+def load_reference(log_dir: Optional[str]) -> dict:
+    if not log_dir:
+        return {}
+    try:
+        with open(_reference_path(log_dir)) as f:
+            return json.load(f) or {}
+    except (OSError, ValueError):
+        return {}
+
+
+def store_reference(log_dir: Optional[str], key: str, fingerprint: str) -> None:
+    """First-writer-wins per ``probe_id:dtype`` key (identical-weight
+    replicas write identical values, so the race is benign); atomic
+    tmp+rename so a torn write never corrupts the reference."""
+    if not log_dir:
+        return
+    path = _reference_path(log_dir)
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    doc = load_reference(log_dir)
+    if key in doc:
+        return
+    doc[key] = fingerprint
+    tmp = f"{path}.tmp.{os.getpid()}"
+    with open(tmp, "w") as f:
+        json.dump(doc, f, indent=2, sort_keys=True)
+    os.replace(tmp, path)
+
+
+class ProbeRunner:
+    """Low-cadence golden-probe thread.
+
+    Submits the probe batch through the engine's NORMAL admission path
+    (``engine.submit`` — so the probe exercises the same batcher,
+    feeder, executable, and depad the live traffic does), but only when
+    the engine is fully idle: any queued or in-flight live work sheds
+    the probe instead (``probe_shed`` on the ledger) — probe traffic
+    never evicts or delays a live request, pinned by test_quality's
+    shed-first test.
+
+    Outcomes land on the stdlib :class:`~sav_tpu.obs.quality.ProbeLedger`
+    the heartbeat ``quality_fn`` snapshots; the expected fingerprint is
+    persisted per ``probe_id:dtype`` under ``log_dir`` so a restarted
+    replica (warm compile cache, same weights) must reproduce its
+    predecessor's bits exactly.
+    """
+
+    def __init__(
+        self,
+        engine,
+        ledger,
+        *,
+        every_s: float,
+        log_dir: Optional[str] = None,
+    ):
+        self._engine = engine
+        self._ledger = ledger
+        self._every_s = max(0.05, float(every_s))
+        self._log_dir = log_dir
+        self._images, self.probe_id = make_probe_batch(
+            engine.config.image_size
+        )
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    # ------------------------------------------------------------ lifecycle
+
+    def start(self) -> "ProbeRunner":
+        self._thread = threading.Thread(
+            target=self._loop, name="serve-probe", daemon=True
+        )
+        self._thread.start()
+        return self
+
+    def close(self, timeout_s: float = 5.0) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=timeout_s)
+
+    def _loop(self) -> None:
+        while not self._stop.wait(self._every_s):
+            try:
+                self.observe_probe()
+            except Exception:
+                # The probe is observability: a failed probe run must
+                # never take the serving loop down with it.
+                self._ledger.record_shed()
+
+    # -------------------------------------------------------------- one run
+
+    def _idle(self) -> bool:
+        batcher = getattr(self._engine, "_batcher", None)
+        if batcher is None:
+            return False
+        stats = batcher.stats()
+        return not stats.get("queued") and not stats.get("inflight")
+
+    def observe_probe(self) -> Optional[bool]:
+        """One probe run: None when shed (engine busy/closed), else
+        whether the fingerprint matched the reference. Named for
+        savlint SAV126's audit set — this function may block on device
+        results precisely because it never runs on the hot path."""
+        if not self._idle():
+            self._ledger.record_shed()
+            return None
+        try:
+            futures = [
+                self._engine.submit(row, deadline_ms=10_000)
+                for row in self._images
+            ]
+        except Exception:
+            self._ledger.record_shed()
+            return None
+        rows = [f.result(timeout=30.0) for f in futures]
+        fingerprint = fingerprint_logits(rows)
+        key = f"{self.probe_id}:{self._engine.serve_dtype}"
+        reference = load_reference(self._log_dir)
+        expected = reference.get(key)
+        if expected is None:
+            # First run under this (probe, dtype): the observed bits
+            # BECOME the reference every later run/restart must match.
+            store_reference(self._log_dir, key, fingerprint)
+            expected = load_reference(self._log_dir).get(key, fingerprint)
+        return self._ledger.record(
+            fingerprint=fingerprint, expected=expected, probe_id=self.probe_id
+        )
+
+
+# ---------------------------------------------------------- chaos seam
+
+
+def noise_params(params, scale: float, seed: int = 0):
+    """Deterministically perturb every float leaf of a param tree
+    (relative to its own std) — the SAV_CHAOS_NOISE_WEIGHTS seam: a
+    planted corrupt replica for the shadow-agreement and
+    probe-mismatch detection tests (docs/quality.md "Chaos")."""
+    rng = np.random.default_rng(int(seed))
+    scale = float(scale)
+
+    def perturb(leaf):
+        arr = np.asarray(leaf)
+        if not np.issubdtype(arr.dtype, np.floating):
+            return leaf
+        std = float(arr.std()) or 1.0
+        noise = rng.standard_normal(arr.shape).astype(arr.dtype)
+        return jnp.asarray(arr + scale * std * noise)
+
+    return jax.tree.map(perturb, params)

@@ -155,7 +155,7 @@ class _Replica:
         "rank", "state", "queued", "inflight", "est_step_s", "p99_ms",
         "last_beat_unix", "beats", "final", "pid", "sends", "routed",
         "completed", "failures", "down_since_unix", "down_reason",
-        "drained_at_unix", "drain_auto",
+        "drained_at_unix", "drain_auto", "dtype",
     )
 
     def __init__(self, rank: int):
@@ -169,6 +169,10 @@ class _Replica:
         self.beats = 0
         self.final = False
         self.pid: Optional[int] = None
+        # Weight-serving dtype stamp from the replica's heartbeats
+        # (ISSUE 20): the shadow scorer keys its tolerance envelope on
+        # the (primary, shadow) dtype pair.
+        self.dtype: Optional[str] = None
         # In-flight sends: job id -> wall stamp (fresh_outstanding =
         # sends newer than the replica's last heartbeat).
         self.sends: dict = {}
@@ -201,13 +205,14 @@ class _Replica:
             "completed": self.completed,
             "failures": self.failures,
             "down_reason": self.down_reason,
+            "dtype": self.dtype,
         }
 
 
 class _Job:
     __slots__ = (
         "jid", "payload", "meta", "deadline_t", "admit_t", "future",
-        "trace", "attempts", "waits",
+        "trace", "attempts", "waits", "shadow",
     )
 
     def __init__(self, jid, payload, meta, deadline_t, admit_t, future):
@@ -223,6 +228,9 @@ class _Job:
         self.trace: Optional[RequestTrace] = None
         self.attempts: list = []
         self.waits: Optional[dict] = None
+        # Shadow sampling mark (ISSUE 20): set at admit (deterministic
+        # 1-in-N), mirrors the completed request to the shadow replica.
+        self.shadow = False
 
 
 _STOP = object()
@@ -230,6 +238,26 @@ _STOP = object()
 # Dispatch workers poll their queue at this cadence so a torn-down
 # router can never strand one (see Router._worker).
 _WORKER_POLL_S = 1.0
+
+#: Bound on queued shadow mirrors (ISSUE 20): a slow shadow replica
+#: sheds its own sampled traffic (``shadow.shed``) instead of growing
+#: an unbounded payload backlog in the router — shed-before-
+#: primary-impact, the probe's contract on the router side.
+SHADOW_QUEUE_DEPTH = 64
+
+#: Wire timeout for one shadow mirror: generous (the shadow is off the
+#: latency path), but bounded so a wedged shadow replica cannot pin the
+#: shadow worker forever.
+SHADOW_SEND_TIMEOUT_S = 10.0
+
+#: Per-mirror request deadline (ms). The mirror is usually the ONLY
+#: row in the otherwise-idle shadow replica's batcher, and inheriting a
+#: live-traffic deadline would let the batcher hold it for seconds of
+#: bucket-fill slack per sample — one mirror scored per drain instead
+#: of dozens. A short deadline ships the batch-of-1 promptly; if the
+#: shadow replica is genuinely busy the sample sheds (report-only),
+#: never a live request.
+SHADOW_MIRROR_DEADLINE_MS = 250.0
 
 
 class Router:
@@ -285,6 +313,13 @@ class Router:
       perf: the overhead meter (``time.perf_counter``) — tracing cost
         is self-accounted exactly like the PR-11 engine telemetry and
         surfaced as ``router_overhead_ms`` per completed request.
+      shadow_rank / shadow_frac: shadow agreement scoring (ISSUE 20,
+        docs/quality.md): mirror a deterministic ``shadow_frac``
+        sample of completed requests to replica ``shadow_rank``
+        (excluded from normal routing) and score top-1 agreement +
+        logit drift per (primary_dtype, shadow_dtype) pair.
+        Report-only — scoring runs on a dedicated worker thread off
+        the latency path and sheds before impacting live traffic.
     """
 
     _POLL_S = 0.02  # no-routable-replica retry cadence inside dispatch
@@ -313,12 +348,18 @@ class Router:
         heartbeat_secs: float = 0.0,
         window_s: float = 30.0,
         perf: Callable[[], float] = time.perf_counter,
+        shadow_rank: Optional[int] = None,
+        shadow_frac: float = 0.05,
     ):
         if max_inflight < 1:
             raise ValueError(f"max_inflight must be >= 1, got {max_inflight}")
         if default_deadline_s <= 0:
             raise ValueError(
                 f"default_deadline_s must be > 0, got {default_deadline_s}"
+            )
+        if shadow_rank is not None and not 0.0 < float(shadow_frac) <= 1.0:
+            raise ValueError(
+                f"shadow_frac must be in (0, 1], got {shadow_frac}"
             )
         self._transport = transport
         self._views_fn = views_fn
@@ -387,6 +428,38 @@ class Router:
                     self._roller = Roller(log_dir)
                 except Exception:
                     self._roller = None
+        # Shadow agreement scoring (ISSUE 20, docs/quality.md): the
+        # designated shadow rank is EXCLUDED from normal routing; a
+        # deterministic 1-in-round(1/frac) sample of completed requests
+        # is mirrored to it from a dedicated worker thread (report-only
+        # — scoring never rides admit/route/_dispatch, SAV126), scored
+        # per (primary_dtype, shadow_dtype) pair, and shed before it
+        # could ever back-pressure live traffic (bounded queue).
+        self.shadow_rank = int(shadow_rank) if shadow_rank is not None else None
+        self.shadow_frac = float(shadow_frac)
+        self._shadow_scorer = None
+        self._shadow_queue: Any = None
+        self._shadow_thread: Optional[threading.Thread] = None
+        self._shadow_every = 0
+        self._shadow_alerts = None
+        if self.shadow_rank is not None:
+            from sav_tpu.obs.quality import AgreementScorer
+
+            self._shadow_scorer = AgreementScorer()
+            self._shadow_every = max(1, round(1.0 / self.shadow_frac))
+            self._shadow_queue = _queue_mod.Queue(maxsize=SHADOW_QUEUE_DEPTH)
+            if self._hb_writer is not None:
+                # Quality rules ONLY: the router beat carries w.p99_ms,
+                # and arming the SLO/env rules here would double-fire
+                # episodes the replicas already own.
+                from sav_tpu.obs import alerts as alerts_mod
+
+                self._shadow_alerts = alerts_mod.AlertEngine(
+                    alerts_mod.quality_rules(),
+                    log_dir=log_dir,
+                    proc="router",
+                    clock=wall_clock,
+                )
         for rank in (ranks or ()):
             self._replicas[int(rank)] = _Replica(int(rank))
         self._refresh_views()  # seed the table before the first admit
@@ -398,6 +471,11 @@ class Router:
             )
             t.start()
             self._workers.append(t)
+        if self._shadow_queue is not None:
+            self._shadow_thread = threading.Thread(
+                target=self._shadow_worker, name="router-shadow", daemon=True
+            )
+            self._shadow_thread.start()
         if self._hb_writer is not None and self.heartbeat_secs > 0:
             self._hb_thread = threading.Thread(
                 target=self._hb_loop, name="router-heartbeat", daemon=True
@@ -445,7 +523,7 @@ class Router:
             waits = [
                 self._projected_wait(r)
                 for r in self._replicas.values()
-                if r.state == ACTIVE
+                if r.state == ACTIVE and r.rank != self.shadow_rank
             ]
             if waits and min(waits) > deadline_s:
                 self._shed_admit += 1
@@ -471,6 +549,14 @@ class Router:
             job.trace = RequestTrace(rid, deadline_s, t_entry)
             stamp(job.trace, "admit", now)
             job.meta["trace"] = rid
+            if self._shadow_every and self._jid % self._shadow_every == 0:
+                # Deterministic 1-in-N sampling (a counter, not a RNG —
+                # reproducible benches): the PRIMARY exchange asks for
+                # logits so the scorer can judge drift, not just top-1.
+                # Integer bookkeeping only — the scoring itself happens
+                # on the shadow worker thread (SAV118/SAV126).
+                job.shadow = True
+                job.meta["want_logits"] = True
             self._overhead_s += self._perf() - t0
             self._inflight_total += 1
         if self._workers:
@@ -531,7 +617,10 @@ class Router:
             waits: dict = {}
             for rank in sorted(self._replicas):
                 replica = self._replicas[rank]
-                if replica.state != ACTIVE:
+                if replica.state != ACTIVE or rank == self.shadow_rank:
+                    # The shadow replica only sees mirrored traffic —
+                    # routing live load at it would make its agreement
+                    # window judge a double-loaded replica.
                     continue
                 wait = self._projected_wait(replica)
                 waits[rank] = wait
@@ -680,6 +769,12 @@ class Router:
                     self._last_complete_t = now
                 job.future.set_result(result)
                 stamp(trace, "completed", self._clock())
+                if job.shadow and rank != self.shadow_rank:
+                    # Hand the completed pair to the shadow worker: one
+                    # bounded put_nowait — never a send, never scoring —
+                    # on the dispatch path (SAV126). Full queue = the
+                    # shadow sheds its own sample.
+                    self._shadow_enqueue(job, rank, result)
                 self._observe_completion(
                     job, rank=rank, outcome="completed",
                     latency_s=now - job.admit_t,
@@ -688,6 +783,121 @@ class Router:
         finally:
             with self._lock:
                 self._inflight_total = max(self._inflight_total - 1, 0)
+
+    # ------------------------------------------------------------- shadow
+
+    def _shadow_enqueue(self, job: _Job, rank: int, result: Any) -> None:
+        """Bounded handoff to the shadow worker (dispatch path: one
+        put_nowait, no scoring — SAV126). A full queue sheds the sample
+        (``shadow.shed``) instead of back-pressuring live traffic."""
+        if self._shadow_queue is None:
+            return
+        try:
+            self._shadow_queue.put_nowait((job.payload, dict(job.meta),
+                                           rank, result))
+        except _queue_mod.Full:
+            self._shadow_scorer.record_shed()
+
+    def _shadow_worker(self) -> None:
+        """Drain mirrored requests and score them — the ONE thread that
+        talks to the shadow replica. Same bounded-poll shutdown shape
+        as the dispatch workers (SAV123)."""
+        while True:
+            try:
+                item = self._shadow_queue.get(timeout=_WORKER_POLL_S)
+            except _queue_mod.Empty:
+                if self._closed.is_set():
+                    return
+                continue
+            if item is _STOP:
+                return
+            try:
+                self._score_one(*item)
+            except Exception:  # noqa: BLE001 — report-only by contract
+                self._shadow_scorer.record_shed()
+
+    def _score_one(self, payload, meta: dict, primary_rank: int,
+                   primary_result: Any) -> None:
+        """Mirror one sampled request to the shadow replica and fold
+        the agreement verdict (shadow worker thread only)."""
+        meta = dict(meta)
+        meta["want_logits"] = True
+        # The mirror must NOT adopt the primary's trace id: the shadow
+        # exchange is observability traffic, and joining it to the live
+        # request's span chain would double-count the request in the
+        # fleet trace merge.
+        meta.pop("trace", None)
+        # Nor the live deadline: the mirror rides an idle batcher, and
+        # a long deadline becomes pure bucket-fill slack per sample.
+        meta["deadline_ms"] = SHADOW_MIRROR_DEADLINE_MS
+        try:
+            shadow_result = self._transport.send(
+                self.shadow_rank, payload, meta, SHADOW_SEND_TIMEOUT_S
+            )
+        except Exception:  # noqa: BLE001 — shed, never propagate
+            self._shadow_scorer.record_shed()
+            return
+        with self._lock:
+            primary = self._replicas.get(primary_rank)
+            shadow = self._replicas.get(self.shadow_rank)
+            primary_dtype = primary.dtype if primary is not None else None
+            shadow_dtype = shadow.dtype if shadow is not None else None
+        if primary_dtype is None or shadow_dtype is None:
+            # Early mirrors can outrun the first dtype-carrying
+            # heartbeat view, and an unknown pair would be judged
+            # against the tight same-dtype envelope — a false breach
+            # on an int8 arm's first samples. Refresh once (worker
+            # thread, off the hot path) before falling back to "?".
+            self._refresh_views()
+            with self._lock:
+                primary = self._replicas.get(primary_rank)
+                shadow = self._replicas.get(self.shadow_rank)
+                if primary is not None and primary.dtype:
+                    primary_dtype = primary.dtype
+                if shadow is not None and shadow.dtype:
+                    shadow_dtype = shadow.dtype
+        p_res = primary_result if isinstance(primary_result, dict) else {}
+        s_res = shadow_result if isinstance(shadow_result, dict) else {}
+        self._shadow_scorer.score_shadow(
+            primary_dtype or "?",
+            shadow_dtype or "?",
+            p_res.get("pred", -1),
+            s_res.get("pred", -1),
+            primary_logits=p_res.get("logits"),
+            shadow_logits=s_res.get("logits"),
+        )
+
+    def _shadow_snapshot(self) -> Optional[dict]:
+        if self._shadow_scorer is None:
+            return None
+        out = self._shadow_scorer.snapshot()
+        out["rank"] = self.shadow_rank
+        out["frac"] = self.shadow_frac
+        with self._lock:
+            primary_dtypes = sorted({
+                r.dtype for rank, r in self._replicas.items()
+                if r.dtype and rank != self.shadow_rank
+            })
+            shadow = self._replicas.get(self.shadow_rank)
+            if shadow is not None and shadow.dtype:
+                out["dtype"] = shadow.dtype
+        if primary_dtypes:
+            out["primary_dtypes"] = primary_dtypes
+        return out
+
+    def _quality_tick(self) -> None:
+        """Evaluate the quality rules against the live shadow snapshot
+        — heartbeat-thread cadence only, the SAV125/SAV126 sanctioned
+        home for alert evaluation."""
+        if self._shadow_alerts is None:
+            return
+        try:
+            snapshot = self._shadow_scorer.snapshot()
+            self._shadow_alerts.observe(
+                {"shadow": snapshot}, now=self._wall()
+            )
+        except Exception:
+            pass  # a broken rule must not stop heartbeating
 
     def note_result(self, rank: int, jid: int, *, ok: bool) -> None:
         """Completion bookkeeping for one send (host counters only,
@@ -816,7 +1026,7 @@ class Router:
                 and self._last_complete_t is not None
             ):
                 span = max(self._last_complete_t - self._first_admit_t, 1e-9)
-            return {
+            out = {
                 "completed": self._completed,
                 "throughput_rps": (
                     round(self._completed / span, 2) if span else None
@@ -832,6 +1042,12 @@ class Router:
                 "router_overhead_ms": self._overhead_ms_locked(),
                 "w": self._window_snapshot(now),
             }
+        # Shadow agreement (ISSUE 20) rides every kind=router beat —
+        # folded OUTSIDE the router lock (the scorer has its own).
+        shadow = self._shadow_snapshot()
+        if shadow is not None:
+            out["shadow"] = shadow
+        return out
 
     def _overhead_ms_locked(self) -> float:
         return round(
@@ -850,6 +1066,7 @@ class Router:
     def _hb_loop(self) -> None:
         while not self._closed.wait(self.heartbeat_secs):
             self.router_beat()
+            self._quality_tick()
             self._roll_tick()
 
     def _roll_tick(self, min_interval_s: float = 2.0) -> None:
@@ -985,6 +1202,9 @@ class Router:
                     replica.last_beat_unix = float(beat_t)
                 replica.beats = int(view.get("beats") or 0)
                 replica.final = bool(view.get("final"))
+                dtype = view.get("dtype")
+                if dtype:
+                    replica.dtype = str(dtype)
                 pid = view.get("pid")
                 if pid is not None:
                     if replica.pid is not None and replica.pid != pid:
@@ -1064,6 +1284,12 @@ class Router:
             self._jobs.put(_STOP)
         for t in self._workers:
             t.join(timeout=5.0)
+        if self._shadow_thread is not None:
+            # After the dispatch workers: nothing can enqueue mirrors
+            # any more, so one _STOP drains whatever was sampled and the
+            # final beat below carries the complete agreement picture.
+            self._shadow_queue.put(_STOP)
+            self._shadow_thread.join(timeout=SHADOW_SEND_TIMEOUT_S + 5.0)
         if self._hb_thread is not None:
             self._hb_thread.join(timeout=5.0)
         if self._hb_writer is not None:
@@ -1071,6 +1297,16 @@ class Router:
             # orderly final record.
             self._hb_writer.serve_beat(self.live(), kind="router")
             self._hb_writer.close()
+        if self._shadow_alerts is not None:
+            # Judge the final snapshot, then resolve whatever is still
+            # firing — exactly one resolved event per open episode (the
+            # monotonic breach counter + this finalize is what makes a
+            # planted fault exactly-once).
+            self._quality_tick()
+            try:
+                self._shadow_alerts.finalize(self._wall())
+            except Exception:
+                pass
         # Fold the final beats into the rollup ladder so post-run
         # readers (console, headroom fold) see the whole run.
         self._roll_tick(min_interval_s=0.0)
@@ -1184,6 +1420,9 @@ class Router:
                     for rank, r in sorted(self._replicas.items())
                 },
             }
+        shadow = self._shadow_snapshot()
+        if shadow is not None:
+            out["shadow"] = shadow
         return out
 
     def write_summary(self) -> Optional[str]:

@@ -710,6 +710,7 @@ class ServeTelemetry:
         autoprof=None,
         queue_stats_fn: Optional[Callable[[], dict]] = None,
         hbm_fn: Optional[Callable[[], Optional[dict]]] = None,
+        quality_fn: Optional[Callable[[], Optional[dict]]] = None,
         max_batch: Optional[int] = None,
         alerts="auto",
     ):
@@ -753,6 +754,12 @@ class ServeTelemetry:
             alerts = None
             if writer is not None:
                 rules = alerts_mod.default_rules(slo_burn_threshold)
+                # Quality rules (ISSUE 20) arm ALONGSIDE the default
+                # set, never inside it — default_rules() stays exactly
+                # the SLO rule (pinned by test_alerts). Beats without
+                # quality fields evaluate them False, so pre-quality
+                # replicas pay nothing.
+                rules = rules + alerts_mod.quality_rules()
                 source = os.environ.get("SAV_ALERT_RULES")
                 if source:
                     rules = rules + alerts_mod.load_rules(source)
@@ -765,6 +772,11 @@ class ServeTelemetry:
         self.alerts = alerts
         self._queue_stats_fn = queue_stats_fn
         self._hbm_fn = hbm_fn
+        # Quality snapshot seam (ISSUE 20): digest drift gates + probe
+        # state folded at beat cadence by the engine's
+        # quality_snapshot — rides every kind=serve beat under
+        # ``quality`` (schema stays v2; readers are forward-compatible).
+        self._quality_fn = quality_fn
         self._lock = threading.Lock()
         self._rid = itertools.count(1)
         self._batches = 0
@@ -1022,6 +1034,22 @@ class ServeTelemetry:
                     record.update(hbm)
             except Exception:
                 pass
+        if self._quality_fn is not None:
+            # Quality fields (ISSUE 20): digest drift gates + probe
+            # fingerprint state, folded by the engine at THIS beat
+            # cadence (never per request — SAV126). Inserted before
+            # alerts.observe so the quality rules see them on the same
+            # beat; the close() path reuses this, so the FINAL beat of
+            # a stopping replica carries its last probe verdict — a
+            # mismatch is on disk even if the replica dies right after.
+            try:
+                quality = self._quality_fn()
+                if quality and (
+                    quality.get("n") or quality.get("probe_runs")
+                ):
+                    record["quality"] = quality
+            except Exception:
+                pass
         if self.autoprof is not None:
             record["captures"] = len(self.autoprof.captures)
         if self.alerts is not None:
@@ -1261,6 +1289,10 @@ def aggregate_serve(
             "exemplars": last.get("exemplars"),
             "captures": last.get("captures"),
             "hbm_peak_bytes": last.get("hbm_peak_bytes"),
+            # Quality fields (ISSUE 20): the last beat's digest gates +
+            # probe verdict — absent on pre-quality streams (readers
+            # skip, never zero-fill).
+            "quality": last.get("quality"),
             "pid": last.get("pid"),
             "final": bool(finals.get(proc)),
             "suspect": proc in suspect_procs,
@@ -1302,6 +1334,15 @@ def aggregate_serve(
             name for v in replicas for name in (v.get("alerts") or [])
         }),
     }
+    # Fleet probe verdict (ISSUE 20): the WORST replica's probe_ok_frac
+    # — one corrupt replica must not hide behind healthy peers. Skipped
+    # (not zero-filled) when no replica ran a probe.
+    probe_ok = [
+        (v.get("quality") or {}).get("probe_ok_frac") for v in replicas
+    ]
+    probe_ok = [p for p in probe_ok if isinstance(p, (int, float))]
+    if probe_ok:
+        summary["fleet"]["probe_ok_frac"] = round(min(probe_ok), 6)
     _fold_capacity(summary, log_dir)
     return summary
 
@@ -1412,6 +1453,10 @@ def router_views(
             "beats": v.get("beats"),
             "final": v.get("final"),
             "suspect": v.get("suspect"),
+            # Replica dtype stamp (ISSUE 20): the router's shadow
+            # scorer keys its tolerance envelope on the (primary,
+            # shadow) dtype pair it reads from here.
+            "dtype": v.get("dtype"),
             "pid": v.get("pid"),
         }
     return views

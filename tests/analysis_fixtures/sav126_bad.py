@@ -1,0 +1,39 @@
+"""Known-bad fixture for SAV126: prediction-quality evaluation dragged
+onto the request path — a windowed digest fold in the batcher's dequeue
+loop, a quality snapshot in the router's admission check, a shadow
+score in the dispatch worker, a resolved quality-module call in a
+telemetry stamp, and a device sync inside the quality fold itself."""
+import jax
+
+from sav_tpu.obs import quality
+
+
+class Batcher:
+    def next_batch(self):
+        b = self._form()
+        self.quality.observe_digests(b.top1, b.margin, b.entropy)
+        return b
+
+
+class Router:
+    def admit(self, payload):
+        if self.quality_tracker.snapshot().get("churn"):
+            raise RuntimeError("shedding")
+        return self._enqueue(payload)
+
+    def _dispatch(self, job):
+        self.shadow_scorer.score_shadow("bf16", "bf16", job.pred, job.pred)
+        self._send(job)
+
+
+class Telemetry:
+    def observe_completed(self, latency_ms):
+        ceiling = quality.envelope_rel("bf16", "int8")
+        self.window.note(latency_ms)
+        return ceiling
+
+
+class Tracker:
+    def observe_digests(self, top1, margin, entropy):
+        top1 = jax.device_get(top1)
+        self._rows.extend(top1)

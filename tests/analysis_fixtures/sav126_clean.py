@@ -1,0 +1,50 @@
+"""Known-clean fixture for SAV126: the quality layer at its sanctioned
+homes — the per-batch digest fold on ALREADY-FETCHED host digests (they
+rode the device loop's one result fetch), snapshots at heartbeat
+cadence, an O(1) bounded handoff (not an evaluation) on the dispatch
+path, scoring on the shadow worker thread, and a probe run that blocks
+on request futures from its own low-cadence thread."""
+
+
+class Engine:
+    def _complete(self, formed, host):
+        # Sanctioned per-batch fold: host["top1"] etc. are host-side
+        # already — quality adds no sync to the device loop's fetch.
+        n = len(formed.requests)
+        self._quality.observe_digests(
+            host["top1"][:n].tolist(),
+            host["margin"][:n].tolist(),
+            host["entropy"][:n].tolist(),
+        )
+
+
+class Telemetry:
+    def serve_beat(self):
+        # Sanctioned cadence: one snapshot per heartbeat, not per
+        # request.
+        record = {"quality": self._quality_fn()}
+        return self.writer.serve_beat(record)
+
+
+class Router:
+    def _dispatch(self, job):
+        self._send(job)
+        if job.shadow:
+            # O(1) bounded queue put — the scoring itself runs on the
+            # shadow worker thread, never on a dispatch worker.
+            self._shadow_enqueue(job)
+
+    def _shadow_worker(self):
+        while not self._closed:
+            job = self._shadow_queue.get(timeout=0.25)
+            self._shadow_scorer.score_shadow(
+                "bf16", "bf16", job.pred, job.shadow_pred
+            )
+
+
+class Probe:
+    def observe_probe(self):
+        # The probe thread may block on request FUTURES — it is off the
+        # hot path by construction; what it must not do is device-sync.
+        rows = [f.result(timeout=30.0) for f in self.futures]
+        return self.ledger.record(fingerprint=self.fp(rows))

@@ -1,0 +1,751 @@
+"""Prediction-quality telemetry (ISSUE 20): in-graph output digests,
+golden-probe fingerprints, and shadow-replica agreement scoring.
+
+Four tiers:
+
+- **Stdlib fold units** (no jax): QualityTracker reference freeze +
+  drift gates (churn TVD / PSI / entropy shift), ProbeLedger counters,
+  AgreementScorer per-dtype envelopes — the int8-shadowing-bf16 arm
+  inside PR-17's quantization envelope is NEVER flagged, the
+  per-dtype-baselines satellite — and the quality alert rules'
+  exactly-one-episode shape on the cumulative monotonic counters.
+- **Router shadow units** (fake transport, no jax, no processes):
+  mirrored sampling via the normal admission path, report-only scoring
+  off the dispatch path, shed-never-propagate on shadow transport
+  failure, the shadow rank's exclusion from live routing, and the
+  planted-disagreement alert episode (firing -> resolved, exactly
+  once).
+- **Device-side primitives + engine e2e** (jax, one engine): the
+  content-addressed probe batch, bit-stable logit fingerprints,
+  first-writer-wins reference persistence, digests riding the serving
+  executable's single result fetch, the probe's shed-before-a-live-
+  request pin, and the final close() beat carrying a probe mismatch
+  (the leave-the-failing-fingerprint-on-disk contract).
+- **Sentinel fixtures both directions** plus the skip-not-zero-fill
+  contract for the quality metrics (a run without probes is not
+  "every probe failed").
+"""
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+import pytest
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+FIXTURES = os.path.join(os.path.dirname(__file__), "sentinel_fixtures")
+
+from sav_tpu.obs.quality import (  # noqa: E402
+    AgreementScorer,
+    ProbeLedger,
+    QualityTracker,
+    envelope_rel,
+    pair_key,
+)
+
+# ------------------------------------------------------ stdlib fold units
+
+
+def test_pair_key_and_envelope_rel():
+    assert pair_key("bf16", "int8") == "bf16->int8"
+    assert pair_key(None, "") == "?->?"
+    # Same-dtype replicas with identical weights are bit-identical
+    # under a fixed executable: tight ceiling.
+    assert envelope_rel("bf16", "bf16") == pytest.approx(0.01)
+    assert envelope_rel("int8", "int8") == pytest.approx(0.01)
+    # Any mixed pair involving int8 inherits PR-17's quantization
+    # envelope (test_quant: rel max-abs-diff <= 0.1).
+    assert envelope_rel("bf16", "int8") == pytest.approx(0.1)
+    assert envelope_rel("int8", "bf16") == pytest.approx(0.1)
+
+
+def test_quality_tracker_empty_then_reference_freeze():
+    tracker = QualityTracker(window=100, reference_min=10)
+    assert tracker.snapshot() == {"n": 0}
+    # Below reference_min: digest medians, no drift gates yet.
+    tracker.observe_digests([1, 2, 3], [0.5, 0.5, 0.5], [1.0, 1.0, 1.0],
+                            num_classes=10)
+    snap = tracker.snapshot()
+    assert snap["n"] == 3 and snap["seen"] == 3
+    assert "churn" not in snap
+    assert snap["entropy_med"] == pytest.approx(1.0)
+    assert snap["margin_med"] == pytest.approx(0.5)
+    # Crossing reference_min freezes the reference; an unchanged
+    # distribution judges as no drift.
+    tracker.observe_digests(
+        list(range(10)) * 2, [0.5] * 20, [1.0] * 20, num_classes=10
+    )
+    snap = tracker.snapshot()
+    assert snap["ref_n"] == 10
+    assert snap["churn"] < 0.2
+    assert snap["entropy_shift"] == pytest.approx(0.0, abs=1e-6)
+    assert snap["psi"] < 0.5
+
+
+def test_quality_tracker_drift_gates_fire_on_shifted_window():
+    # Reference: uniform top-1 over 10 classes, entropy ~2.3 with a
+    # little spread (a zero-MAD reference would make any shift an
+    # infinite z — the denominator floor keeps it finite, but a
+    # realistic spread exercises the MAD path).
+    tracker = QualityTracker(window=100, reference_min=100)
+    rng = np.random.default_rng(0)
+    ref_entropy = (2.3 + 0.05 * rng.standard_normal(100)).tolist()
+    tracker.observe_digests(
+        [i % 10 for i in range(100)], [0.4] * 100, ref_entropy,
+        num_classes=10,
+    )
+    baseline = tracker.snapshot()
+    assert baseline["churn"] == pytest.approx(0.0, abs=1e-6)
+    # Drifted regime: predictions collapse onto one class, entropy
+    # collapses too (the classic corrupted-head signature). The window
+    # fully displaces (window == number of drifted rows).
+    tracker.observe_digests([3] * 100, [5.0] * 100, [0.1] * 100,
+                            num_classes=10)
+    snap = tracker.snapshot()
+    # TVD between uniform(10) and a point mass = 0.9 — over the 0.5
+    # churn-rule gate.
+    assert snap["churn"] == pytest.approx(0.9, abs=1e-6)
+    assert snap["entropy_shift"] > 6.0
+    assert snap["psi"] > 1.0
+    # The reference stayed FROZEN: drift did not get absorbed into it.
+    assert snap["ref_n"] == 100
+
+
+def test_probe_ledger_counters_and_mismatch_details():
+    ledger = ProbeLedger()
+    snap = ledger.snapshot()
+    assert snap["probe_runs"] == 0
+    assert "probe_ok_frac" not in snap  # skip, never zero-fill
+    assert ledger.record(fingerprint="aa", expected="aa", probe_id="p1")
+    assert not ledger.record(fingerprint="bb", expected="aa", probe_id="p1")
+    ledger.record_shed()
+    snap = ledger.snapshot()
+    assert snap["probe_runs"] == 2 and snap["probe_ok"] == 1
+    assert snap["probe_mismatch"] == 1 and snap["probe_shed"] == 1
+    assert snap["probe_ok_frac"] == pytest.approx(0.5)
+    # The failing fingerprint AND what it should have been are both in
+    # the snapshot — the final close() beat ships them to disk.
+    assert snap["probe_fingerprint"] == "bb"
+    assert snap["probe_expected"] == "aa"
+    # A matching run drops the expected/observed split.
+    ledger.record(fingerprint="aa", expected="aa", probe_id="p1")
+    assert "probe_expected" not in ledger.snapshot()
+
+
+def test_agreement_scorer_same_dtype_breaches_on_drift():
+    scorer = AgreementScorer()
+    verdict = scorer.score_shadow(
+        "bf16", "bf16", 2, 2,
+        primary_logits=[0.0, 1.0, 10.0], shadow_logits=[0.0, 1.0, 10.0],
+    )
+    assert not verdict["breach"] and verdict["rel_diff"] == pytest.approx(0.0)
+    # Same argmax but logits drifted 5% — over the 1% same-dtype
+    # ceiling: bit-identical replicas should never disagree this much.
+    verdict = scorer.score_shadow(
+        "bf16", "bf16", 2, 2,
+        primary_logits=[0.0, 1.0, 10.0], shadow_logits=[0.0, 1.5, 10.0],
+    )
+    assert verdict["breach"] and verdict["rel_diff"] == pytest.approx(0.05)
+    # Outright top-1 disagreement breaches even without logits.
+    assert scorer.score_shadow("bf16", "bf16", 2, 7)["breach"]
+    snap = scorer.snapshot()
+    assert snap["scored"] == 3 and snap["breach"] == 2
+    pair = snap["pairs"]["bf16->bf16"]
+    assert pair["n"] == 3
+    assert pair["agreement"] == pytest.approx(2 / 3)
+    assert pair["envelope_rel"] == pytest.approx(0.01)
+    assert pair["rel_diff_max"] == pytest.approx(0.05)
+
+
+def test_agreement_scorer_int8_shadow_inside_quant_envelope_not_flagged():
+    """The per-dtype-baselines satellite: an int8 replica shadowing a
+    bf16 primary is judged against PR-17's quantization envelope (same
+    argmax, rel max-abs-diff <= 0.1) and must NEVER be flagged by the
+    same-dtype rule."""
+    scorer = AgreementScorer()
+    primary = [0.0, 2.0, 10.0]
+    # 8% relative drift: far over the 1% same-dtype ceiling, safely
+    # inside the 10% int8 envelope.
+    shadow = [0.0, 2.0, 10.8]
+    verdict = scorer.score_shadow(
+        "bf16", "int8", 2, 2, primary_logits=primary, shadow_logits=shadow
+    )
+    assert verdict["rel_diff"] == pytest.approx(0.08)
+    assert not verdict["breach"]
+    # The same drift on a same-dtype pair DOES breach — the envelopes
+    # are per-pair, not global.
+    assert scorer.score_shadow(
+        "bf16", "bf16", 2, 2, primary_logits=primary, shadow_logits=shadow
+    )["breach"]
+    # Past the int8 envelope the mixed pair breaches too.
+    assert scorer.score_shadow(
+        "bf16", "int8", 2, 2,
+        primary_logits=primary, shadow_logits=[0.0, 2.0, 11.5],
+    )["breach"]
+    snap = scorer.snapshot()
+    assert snap["pairs"]["bf16->int8"]["envelope_rel"] == pytest.approx(0.1)
+    # Fleet-level agreement is the WORST pair, so a healthy pair can't
+    # mask a drifting one.
+    assert snap["agreement"] == pytest.approx(
+        min(e["agreement"] for e in snap["pairs"].values())
+    )
+
+
+# ----------------------------------------------------- quality alert rules
+
+
+def test_quality_rules_fire_exactly_one_episode_on_monotonic_counters(
+    tmp_path,
+):
+    """A planted fault increments a CUMULATIVE counter; the for_s=0
+    rule fires once, stays quiet while the counter keeps the same
+    nonzero value, and resolves exactly once at finalize."""
+    from sav_tpu.obs.alerts import (
+        AlertEngine,
+        episodes,
+        quality_rules,
+        read_alerts,
+    )
+
+    d = str(tmp_path)
+    eng = AlertEngine(quality_rules(), log_dir=d, proc="router")
+    # Records without quality fields (training beats, pre-reference
+    # windows) evaluate False — missing metrics never fire.
+    assert eng.observe({"w": {"p99_ms": 9.0}}, now=100.0) == []
+    assert eng.observe({"shadow": {"breach": 0, "scored": 5}}, now=101.0) == []
+    events = eng.observe({"shadow": {"breach": 1, "scored": 6}}, now=102.0)
+    assert [(e["event"], e["rule"]) for e in events] == [
+        ("firing", "shadow-agreement")
+    ]
+    # Monotonic counter stays at 1 (or grows): same episode, no repeat.
+    assert eng.observe({"shadow": {"breach": 1}}, now=103.0) == []
+    assert eng.observe({"shadow": {"breach": 3}}, now=110.0) == []
+    # The probe-mismatch rule is independent and fires its own episode.
+    events = eng.observe(
+        {"shadow": {"breach": 3}, "quality": {"probe_mismatch": 1}},
+        now=111.0,
+    )
+    assert [(e["event"], e["rule"]) for e in events] == [
+        ("firing", "quality-probe-mismatch")
+    ]
+    eng.finalize(120.0)
+    eps = episodes(read_alerts(d))
+    assert eps["shadow-agreement"]["fired"] == 1
+    assert eps["shadow-agreement"]["resolved"] == 1
+    assert eps["shadow-agreement"]["active"] is False
+    assert eps["quality-probe-mismatch"]["fired"] == 1
+
+
+def test_quality_rules_are_separate_from_default_rules():
+    from sav_tpu.obs.alerts import default_rules, quality_rules
+
+    assert [r.name for r in default_rules()] == ["slo-burn"]
+    names = [r.name for r in quality_rules()]
+    assert names == [
+        "quality-churn", "quality-entropy-shift",
+        "quality-probe-mismatch", "shadow-agreement",
+    ]
+    by_name = {r.name: r for r in quality_rules()}
+    # Integrity rules: instant-fire on the monotonic counters, long
+    # resolve (one episode per faulty executable).
+    assert by_name["shadow-agreement"].for_s == 0.0
+    assert by_name["quality-probe-mismatch"].severity == "page"
+    # Drift rules debounce with for/resolve holds instead.
+    assert by_name["quality-churn"].for_s > 0.0
+    assert by_name["quality-churn"].severity == "warn"
+
+
+def test_rollup_flattens_quality_and_shadow_numerics():
+    from sav_tpu.obs.rollup import metrics_from
+
+    serve = metrics_from({
+        "kind": "serve",
+        "quality": {
+            "n": 12, "churn": 0.1, "probe_ok_frac": 1.0,
+            "probe_id": "abc123",  # strings never roll
+        },
+    })
+    assert serve["quality_n"] == 12.0
+    assert serve["quality_churn"] == pytest.approx(0.1)
+    assert serve["quality_probe_ok_frac"] == pytest.approx(1.0)
+    assert "quality_probe_id" not in serve
+    router = metrics_from({
+        "kind": "router",
+        "shadow": {
+            "scored": 5, "breach": 0, "agreement": 1.0,
+            "pairs": {"bf16->bf16": {"n": 5}},  # nested: not rollable
+        },
+    })
+    assert router["router_shadow_scored"] == 5.0
+    assert router["router_shadow_agreement"] == pytest.approx(1.0)
+    assert router["router_shadow_breach"] == 0.0
+    assert "router_shadow_pairs" not in router
+    # kind mismatch rolls nothing: a router beat's shadow block must
+    # not masquerade as replica quality (and vice versa).
+    assert "quality_churn" not in metrics_from(
+        {"kind": "router", "quality": {"churn": 0.9}}
+    )
+
+
+# ------------------------------------------------------ router shadow units
+
+
+class _Clock:
+    def __init__(self, t=0.0):
+        self.t = float(t)
+
+    def __call__(self):
+        return self.t
+
+    def sleep(self, s):
+        self.t += float(s)
+
+
+class _Transport:
+    """Scripted per-rank replies; records every (rank, meta) send."""
+
+    def __init__(self, behavior):
+        self.behavior = dict(behavior)
+        self.sends = []
+
+    def send(self, rank, payload, meta, timeout_s):
+        self.sends.append((rank, dict(meta)))
+        b = self.behavior[rank]
+        if callable(b):
+            b = b()
+        if isinstance(b, BaseException):
+            raise b
+        return b
+
+
+def _view(**kw):
+    base = {
+        "queued": 0, "inflight": 0, "est_step_s": 0.01, "p99_ms": 10.0,
+        "last_beat_unix": 100.0, "beats": 5, "final": False,
+        "suspect": False, "pid": 1000,
+    }
+    base.update(kw)
+    return base
+
+
+def _shadow_router(views, transport, **kw):
+    from sav_tpu.serve.router import Router
+
+    clock = _Clock()
+    defaults = dict(
+        views_fn=lambda: views,
+        max_batch=2,
+        default_step_s=0.01,
+        default_deadline_s=5.0,
+        refresh_secs=0.0,
+        workers=0,  # synchronous dispatch: admit blocks until resolved
+        clock=clock,
+        wall_clock=_Clock(100.0),
+        sleep=clock.sleep,
+        shadow_rank=1,
+        shadow_frac=1.0,  # every request sampled: deterministic
+    )
+    defaults.update(kw)
+    return Router(transport, **defaults)
+
+
+def _wait_scored(router, n, timeout_s=10.0):
+    """The scorer folds on the shadow worker thread — poll until it
+    has seen n samples (real time; the worker wakes at poll cadence)."""
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        snap = router._shadow_scorer.snapshot()
+        if snap["scored"] + snap["shed"] >= n:
+            return snap
+        time.sleep(0.01)
+    raise AssertionError(f"shadow never scored {n} samples")
+
+
+def test_router_shadow_mirrors_samples_and_scores_agreement():
+    result = {"ok": True, "pred": 7, "logits": [0.0, 1.0, 4.0]}
+    views = {0: _view(dtype="bf16"), 1: _view(dtype="bf16")}
+    transport = _Transport({0: dict(result), 1: dict(result)})
+    router = _shadow_router(views, transport)
+    # The shadow rank never takes live traffic.
+    assert router.route() == 0
+    for _ in range(3):
+        assert router.admit(b"img").result(timeout=5.0)["pred"] == 7
+    _wait_scored(router, 3)
+    router.close()
+    shadow = router.summary()["shadow"]
+    assert shadow["rank"] == 1 and shadow["frac"] == pytest.approx(1.0)
+    assert shadow["scored"] == 3 and shadow["breach"] == 0
+    assert shadow["agreement"] == pytest.approx(1.0)
+    assert shadow["pairs"]["bf16->bf16"]["n"] == 3
+    assert shadow["dtype"] == "bf16"
+    assert shadow["primary_dtypes"] == ["bf16"]
+    # Every live send went to rank 0, every mirror to rank 1.
+    primary = [m for r, m in transport.sends if r == 0]
+    mirrors = [m for r, m in transport.sends if r == 1]
+    assert len(primary) == 3 and len(mirrors) == 3
+    # Sampled primaries ask for logits so the scorer can judge drift;
+    # the mirror must NOT adopt the live trace id (observability
+    # traffic joining the span chain would double-count the request).
+    assert all(m.get("want_logits") for m in primary)
+    assert all(m.get("want_logits") for m in mirrors)
+    assert all("trace" in m for m in primary)
+    assert all("trace" not in m for m in mirrors)
+    # live() carries the same block the router heartbeat ships.
+    assert router.live()["shadow"]["scored"] == 3
+
+
+def test_router_shadow_int8_pair_judged_against_quant_envelope():
+    primary = {"ok": True, "pred": 2, "logits": [0.0, 2.0, 10.0]}
+    # 8% rel drift, same argmax: inside PR-17's int8 envelope.
+    shadow = {"ok": True, "pred": 2, "logits": [0.0, 2.0, 10.8]}
+    views = {0: _view(dtype="bf16"), 1: _view(dtype="int8")}
+    router = _shadow_router(views, _Transport({0: primary, 1: shadow}))
+    router.admit(b"img").result(timeout=5.0)
+    _wait_scored(router, 1)
+    router.close()
+    out = router.summary()["shadow"]
+    assert out["breach"] == 0 and out["agreement"] == pytest.approx(1.0)
+    pair = out["pairs"]["bf16->int8"]
+    assert pair["envelope_rel"] == pytest.approx(0.1)
+    assert pair["rel_diff_max"] == pytest.approx(0.08)
+    assert out["dtype"] == "int8" and out["primary_dtypes"] == ["bf16"]
+
+
+def test_router_shadow_disagreement_fires_exactly_one_alert_episode(
+    tmp_path,
+):
+    """The planted-perturbation shape, router-side: a shadow replica
+    that disagrees on top-1 drives breach > 0; the quality rules fire
+    ONE shadow-agreement episode across many beats and resolve it at
+    close — never one episode per breaching sample."""
+    from sav_tpu.obs.alerts import episodes, read_alerts
+
+    views = {0: _view(dtype="bf16"), 1: _view(dtype="bf16")}
+    transport = _Transport({
+        0: {"ok": True, "pred": 7, "logits": [0.0, 1.0, 4.0]},
+        1: {"ok": True, "pred": 3, "logits": [9.0, 1.0, 0.0]},
+    })
+    router = _shadow_router(views, transport, log_dir=str(tmp_path))
+    for i in range(3):
+        router.admit(b"img").result(timeout=5.0)
+        _wait_scored(router, i + 1)
+        router._quality_tick()  # the heartbeat thread's cadence
+    snap = router._shadow_scorer.snapshot()
+    assert snap["breach"] == 3
+    assert snap["agreement"] == pytest.approx(0.0)
+    router.close()
+    events = read_alerts(str(tmp_path))
+    quality_events = [
+        (e["event"], e["rule"], e["proc"]) for e in events
+        if e["rule"] == "shadow-agreement"
+    ]
+    assert quality_events == [
+        ("firing", "shadow-agreement", "router"),
+        ("resolved", "shadow-agreement", "router"),
+    ]
+    eps = episodes(events)
+    assert eps["shadow-agreement"]["fired"] == 1
+    assert eps["shadow-agreement"]["active"] is False
+
+
+def test_router_shadow_transport_failure_sheds_report_only():
+    """A dead shadow replica must cost live traffic nothing: the
+    mirror sheds (counted), the live request completes normally, and
+    no exception escapes the worker."""
+    from sav_tpu.serve.router import ReplicaTransportError
+
+    views = {0: _view(dtype="bf16"), 1: _view(dtype="bf16")}
+    transport = _Transport({
+        0: {"ok": True, "pred": 7},
+        1: ReplicaTransportError("shadow down"),
+    })
+    router = _shadow_router(views, transport)
+    assert router.admit(b"img").result(timeout=5.0)["pred"] == 7
+    snap = _wait_scored(router, 1)
+    router.close()
+    assert snap["shed"] == 1 and snap["scored"] == 0
+    assert "agreement" not in snap  # nothing scored: skip, never fake
+    assert router.summary()["completed"] == 1
+
+
+def test_router_shadow_validation():
+    from sav_tpu.serve.router import Router
+
+    with pytest.raises(ValueError, match="shadow_frac"):
+        Router(
+            _Transport({}), views_fn=lambda: {}, workers=0,
+            shadow_rank=1, shadow_frac=0.0,
+        )
+
+
+# ------------------------------------- device-side primitives + engine e2e
+
+
+def test_make_probe_batch_is_content_addressed_and_deterministic():
+    from sav_tpu.serve.quality import PROBE_ROWS, make_probe_batch
+
+    a, id_a = make_probe_batch(32)
+    b, id_b = make_probe_batch(32)
+    assert a.shape == (PROBE_ROWS, 32, 32, 3) and a.dtype == np.uint8
+    assert np.array_equal(a, b) and id_a == id_b
+    # The id names the BYTES: a different shape is a different probe,
+    # and its fingerprint can never be compared against this one's.
+    _, id_c = make_probe_batch(48)
+    _, id_d = make_probe_batch(32, rows=2)
+    assert len({id_a, id_c, id_d}) == 3
+
+
+def test_fingerprint_logits_bit_stable():
+    from sav_tpu.serve.quality import fingerprint_logits
+
+    rows = [np.arange(10, dtype=np.float32), np.ones(10, np.float32)]
+    assert fingerprint_logits(rows) == fingerprint_logits(
+        [np.array(r) for r in rows]
+    )
+    # One ULP-scale nudge in one element changes the fingerprint: the
+    # probe proves bit identity, not approximate closeness.
+    bumped = [rows[0].copy(), rows[1].copy()]
+    bumped[1][3] = np.float32(1.0 + 1e-6)
+    assert fingerprint_logits(bumped) != fingerprint_logits(rows)
+
+
+def test_store_reference_first_writer_wins(tmp_path):
+    from sav_tpu.serve.quality import load_reference, store_reference
+
+    d = str(tmp_path)
+    assert load_reference(d) == {}
+    store_reference(d, "p1:bf16", "aaaa")
+    # A racing second writer (another identical-weights replica) can't
+    # overwrite the frozen reference.
+    store_reference(d, "p1:bf16", "bbbb")
+    store_reference(d, "p1:int8", "cccc")  # per-dtype keys coexist
+    ref = load_reference(d)
+    assert ref == {"p1:bf16": "aaaa", "p1:int8": "cccc"}
+    # None log_dir is a no-op on both sides (log-less engines).
+    store_reference(None, "k", "v")
+    assert load_reference(None) == {}
+
+
+def test_noise_params_deterministic_and_float_only():
+    from sav_tpu.serve.quality import noise_params
+
+    params = {
+        "dense": {"kernel": np.linspace(-1, 1, 12, dtype=np.float32)},
+        "scale": np.array([3, 5], dtype=np.int8),
+    }
+    a = noise_params(params, 0.5, seed=0)
+    b = noise_params(params, 0.5, seed=0)
+    np.testing.assert_array_equal(
+        np.asarray(a["dense"]["kernel"]), np.asarray(b["dense"]["kernel"])
+    )
+    assert not np.array_equal(
+        np.asarray(a["dense"]["kernel"]), params["dense"]["kernel"]
+    )
+    # Quantized int leaves pass through untouched — the chaos seam
+    # corrupts the float tree before quantization, never the int bits.
+    np.testing.assert_array_equal(np.asarray(a["scale"]), params["scale"])
+
+
+def _tiny_config(**overrides):
+    from sav_tpu.serve.engine import ServeConfig
+
+    base = dict(
+        model_name="vit_ti_patch16",
+        num_classes=10,
+        image_size=32,
+        model_overrides={"num_layers": 1},
+        # One bucket on purpose: every bucket is its own AOT compile,
+        # and both the 3-request live burst and the 4-row probe fit
+        # the 4-bucket — tier-1 seconds matter at the 870s budget.
+        buckets=[4],
+        max_queue=128,
+        deadline_ms=300.0,
+    )
+    base.update(overrides)
+    return ServeConfig(**base)
+
+
+def _requests(n, image_size=32, seed=0):
+    rng = np.random.default_rng(seed)
+    return [
+        rng.integers(0, 256, (image_size, image_size, 3), dtype=np.uint8)
+        for _ in range(n)
+    ]
+
+
+def test_engine_digests_probe_verdicts_and_final_beat(tmp_path):
+    """One engine session, the whole quality surface: digests folded
+    from the existing result fetch, the probe shedding before a live
+    request, fingerprint-vs-reference verdicts, and the final close()
+    beat + manifest carrying a mismatch to disk."""
+    from sav_tpu.obs.fleet import read_heartbeats
+    from sav_tpu.serve.engine import ServeEngine
+    from sav_tpu.serve.quality import ProbeRunner, _reference_path
+
+    engine = ServeEngine(_tiny_config(log_dir=str(tmp_path)))
+    with engine:
+        futures = [engine.submit(img) for img in _requests(3)]
+        for f in futures:
+            f.result(timeout=30.0)
+        quality = engine.stats()["quality"]
+        assert quality["n"] == 3 and quality["seen"] == 3
+        # Zero-init head -> near-uniform logits: entropy ~ ln(10).
+        assert 0.0 < quality["entropy_med"] <= np.log(10) + 0.1
+        assert quality["margin_med"] >= 0.0
+        assert quality["probe_runs"] == 0
+
+        runner = ProbeRunner(
+            engine, engine._probe_ledger, every_s=999,
+            log_dir=str(tmp_path),
+        )
+        # Shed-first pin: any queued/in-flight live work sheds the
+        # probe — probe traffic never queues behind (or evicts) a live
+        # request.
+        real_stats = engine._batcher.stats
+        engine._batcher.stats = lambda: {"queued": 2, "inflight": 0}
+        assert runner.observe_probe() is None
+        engine._batcher.stats = real_stats
+        assert engine._probe_ledger.shed == 1
+
+        # First probe run freezes the reference; a re-run under the
+        # same executable + weights reproduces the bits exactly.
+        assert runner.observe_probe() is True
+        key = f"{runner.probe_id}:{engine.serve_dtype}"
+        with open(_reference_path(str(tmp_path))) as f:
+            ref = json.load(f)
+        assert ref[key] == engine._probe_ledger.last
+        assert runner.observe_probe() is True
+
+        # Plant a corrupted reference (stand-in for "the weights
+        # changed under us"): the next probe must mismatch.
+        with open(_reference_path(str(tmp_path)), "w") as f:
+            json.dump({key: "deadbeef"}, f)
+        assert runner.observe_probe() is False
+        snap = engine._probe_ledger.snapshot()
+        assert snap["probe_mismatch"] == 1
+        assert snap["probe_ok_frac"] == pytest.approx(2 / 3)
+        assert snap["probe_expected"] == "deadbeef"
+    summary = engine.stop()
+    assert summary["requests"] == 3 + 3 * len(runner._images)
+    # The FINAL beat (close() reuses serve_beat) left the failing
+    # fingerprint on disk — a replica stopped right after a mismatch
+    # still tells the story.
+    beats = read_heartbeats(str(tmp_path))[0]
+    quality_beats = [
+        b["quality"] for b in beats if isinstance(b.get("quality"), dict)
+    ]
+    assert quality_beats
+    final = quality_beats[-1]
+    assert final["probe_mismatch"] == 1
+    assert final["probe_expected"] == "deadbeef"
+    assert final["probe_fingerprint"] == ref[key]
+    # Manifest: notes.quality + the sentinel-facing probe metric.
+    manifests = [
+        f for f in os.listdir(tmp_path) if f.startswith("manifest")
+    ]
+    assert len(manifests) == 1
+    with open(os.path.join(tmp_path, manifests[0])) as f:
+        data = json.load(f)
+    assert data["notes"]["quality"]["probe_mismatch"] == 1
+    assert data["metrics"]["serve/probe_ok_frac"] == pytest.approx(2 / 3)
+
+
+@pytest.mark.slow
+def test_probe_fingerprint_stable_across_restart_and_detects_noise(
+    tmp_path, monkeypatch,
+):
+    """Weight-integrity proof across a warm-cache restart: a fresh
+    engine over the same weights reproduces the reference bits
+    exactly; a chaos-noised engine (SAV_CHAOS_NOISE_WEIGHTS) is caught
+    by the very first probe."""
+    from sav_tpu.serve.engine import ServeEngine
+    from sav_tpu.serve.quality import ProbeRunner
+
+    d = str(tmp_path)
+
+    def probe_once(engine):
+        runner = ProbeRunner(
+            engine, engine._probe_ledger, every_s=999, log_dir=d
+        )
+        return runner.observe_probe()
+
+    with ServeEngine(_tiny_config(log_dir=d)) as engine:
+        assert probe_once(engine) is True  # freezes the reference
+    engine.stop()
+    # Restart: new engine object, same weights, same (cached)
+    # executable — the fingerprint must match bit-for-bit.
+    with ServeEngine(_tiny_config(log_dir=d)) as engine:
+        assert probe_once(engine) is True
+    engine.stop()
+    # Planted corruption: the chaos seam perturbs the float tree at
+    # load, and the probe flags it before any traffic is served.
+    monkeypatch.setenv("SAV_CHAOS_NOISE_WEIGHTS", "0.5")
+    with ServeEngine(_tiny_config(log_dir=d)) as engine:
+        assert probe_once(engine) is False
+        assert engine._probe_ledger.snapshot()["probe_mismatch"] == 1
+    engine.stop()
+
+
+# --------------------------------------------------- sentinel fixtures
+
+
+def test_sentinel_scores_quality_fixtures_both_directions(capsys):
+    sys.path.insert(0, os.path.join(ROOT, "tools"))
+    try:
+        import regression_sentinel as sentinel
+    finally:
+        sys.path.pop(0)
+    assert sentinel.main([os.path.join(FIXTURES, "quality_clean")]) == 0
+    clean_out = capsys.readouterr().out
+    assert "ok      quality_agreement" in clean_out
+    assert "ok      probe_ok_frac" in clean_out
+    assert sentinel.main(
+        ["--json", os.path.join(FIXTURES, "quality_regressed")]
+    ) == 1
+    report = json.loads(capsys.readouterr().out)
+    flagged = {v["metric"] for v in report["verdicts"] if v["regressed"]}
+    assert flagged == {"quality_agreement", "probe_ok_frac"}
+
+
+def test_sentinel_skips_records_without_quality_metrics():
+    """The attention_core_frac presence contract, for quality: serving
+    records without probes/shadows are skipped (not zero-filled), and
+    a probe-less candidate after quality history is not scorable."""
+    sys.path.insert(0, os.path.join(ROOT, "tools"))
+    try:
+        from regression_sentinel import judge_metric
+    finally:
+        sys.path.pop(0)
+    from sav_tpu.obs.manifest import normalize_run_record
+
+    def quality_line(agreement, i):
+        return normalize_run_record(
+            {
+                "outcome": "ok", "p99_latency_ms": 20.0,
+                "quality_agreement": agreement, "probe_ok_frac": 1.0,
+            },
+            label=f"q{i}", index=i,
+        )
+
+    def plain_line(i):
+        return normalize_run_record(
+            {"outcome": "ok", "p99_latency_ms": 20.0, "serve_throughput": 400.0},
+            label=f"p{i}", index=i,
+        )
+
+    kw = dict(k=3.5, rel_floor=0.05, min_history=2)
+    # Plain serving history: quality metrics not scorable at all.
+    records = [plain_line(i) for i in range(4)]
+    assert judge_metric(records, "quality_agreement", **kw) is None
+    # Quality history + a plain candidate: judging would re-flag a
+    # STALE record as the candidate — not scorable.
+    records = [quality_line(1.0, i) for i in range(3)] + [plain_line(3)]
+    assert judge_metric(records, "quality_agreement", **kw) is None
+    # With a quality candidate present, a genuine drop IS flagged.
+    records = [quality_line(1.0, i) for i in range(3)] + [
+        quality_line(0.8, 3)
+    ]
+    verdict = judge_metric(records, "quality_agreement", **kw)
+    assert verdict is not None and verdict.regressed

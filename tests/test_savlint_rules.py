@@ -171,6 +171,13 @@ BAD_EXPECTATIONS = {
         ("SAV125", 23),  # .roll_once() on the roller in _dispatch()
         ("SAV125", 29),  # resolved sav_tpu.obs.alerts call in a stamp
     ],
+    "sav126_bad.py": [
+        ("SAV126", 14),  # .observe_digests() on a quality fold in next_batch()
+        ("SAV126", 20),  # .snapshot() on a quality tracker in admit()
+        ("SAV126", 25),  # .score_shadow() on the scorer in _dispatch()
+        ("SAV126", 31),  # resolved sav_tpu.obs.quality call in a stamp
+        ("SAV126", 38),  # jax.device_get inside the quality fold itself
+    ],
 }
 
 CLEAN_FIXTURES = [
@@ -199,6 +206,7 @@ CLEAN_FIXTURES = [
     "sav_tpu/serve/sav123_clean.py",
     "sav124_clean.py",
     "sav125_clean.py",
+    "sav126_clean.py",
 ]
 
 

@@ -160,6 +160,26 @@ def test_router_hot_path_suppressions_are_zero():
         assert one.suppressed == []
 
 
+def test_quality_eval_suppressions_are_zero():
+    """SAV126 (quality-eval-in-hot-path, ISSUE 20): prediction-quality
+    telemetry holds its zero-sync/zero-per-request-eval contract with
+    ZERO suppressions — the digests ride the device loop's one result
+    fetch, probes run on the probe thread, shadow scoring on the shadow
+    worker, snapshots at heartbeat cadence. The quality modules
+    themselves lint fully clean (the obs side is stdlib-only; the serve
+    side never touches a device value outside the traced digest fn)."""
+    result = _self_lint()
+    assert [f for f in result.findings if f.rule == "SAV126"] == []
+    assert [f for f in result.suppressed if f.rule == "SAV126"] == []
+    for path in (
+        os.path.join(ROOT, "sav_tpu", "obs", "quality.py"),
+        os.path.join(ROOT, "sav_tpu", "serve", "quality.py"),
+    ):
+        one = lint_paths([path], root=ROOT)
+        assert one.findings == []
+        assert one.suppressed == []
+
+
 def test_adhoc_partition_spec_suppressions_are_zero():
     """SAV117 (adhoc-partition-spec): every PartitionSpec/NamedSharding
     outside sav_tpu/parallel/ derives from the SpecLayout — the rule

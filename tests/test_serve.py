@@ -379,11 +379,15 @@ def test_engine_serves_correct_results_and_masks_padding(tmp_path):
         np.stack(images + [np.zeros_like(images[0])]),
         np.array([1, 1, 0, 0], np.float32),
     )
-    out = np.asarray(
-        engine._executables[4](params, engine._batch_stats, placed)
-    )
+    direct = engine._executables[4](params, engine._batch_stats, placed)
+    out = np.asarray(direct["logits"])
     assert np.all(out[2:] == 0.0)
     assert np.any(out[:2] != 0.0)
+    # The quality digest leaves (ISSUE 20) ride the same program and
+    # the same validity mask: padded rows digest to zero.
+    assert np.all(np.asarray(direct["margin"])[2:] == 0.0)
+    assert np.all(np.asarray(direct["top1"])[2:] == 0)
+    assert np.all(np.asarray(direct["entropy"])[2:] == 0.0)
     summary = engine.stop()
     assert summary["requests"] == 3
     assert summary["bucket_occupancy"]["4"]["batches"] == 1

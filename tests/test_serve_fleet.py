@@ -1016,6 +1016,7 @@ def test_replica_flag_vocabulary_consistent_across_tools():
         "--heartbeat-secs", "--slo-target", "--model-overrides",
         "--buckets", "--checkpoint", "--layout-preset",
         "--compilation-cache-dir", "--attn-tune-cache",
+        "--probe-every",
     }
     missing = forwarded - fleet_flags
     assert not missing, (
@@ -1038,6 +1039,7 @@ def test_replica_flag_vocabulary_consistent_across_tools():
         model_overrides='{"num_layers": 1}', buckets="1,2",
         checkpoint=None, layout_preset=None,
         compilation_cache_dir="/tmp/cache", attn_tune_cache=None,
+        probe_every=5.0,
     )
     argv = serve_fleet.replica_argv(ns, 1, "/tmp/logs")[2:]
     fleet_parser.add_argument("--replica-rank", type=int)
@@ -1051,6 +1053,7 @@ def test_replica_flag_vocabulary_consistent_across_tools():
     assert parsed.buckets == "1,2"
     assert parsed.model_overrides == '{"num_layers": 1}'
     assert parsed.compilation_cache_dir == "/tmp/cache"
+    assert parsed.probe_every == 5.0
     assert parsed.manifest.endswith("manifest-serve-r1.json")
 
 

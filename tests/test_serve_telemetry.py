@@ -471,10 +471,14 @@ def test_capacity_stamp_and_alert_rules_ride_the_beat(
         str(tmp_path), clock=clock, wall_clock=clock, writer=writer,
         max_batch=8, heartbeat_secs=0.0,
     )
-    # Armed rule set: the built-in SLO burn rule plus the env rule.
-    assert [r.name for r in telemetry.alerts.rules] == [
-        "slo-burn", "hot-p99",
-    ]
+    # Armed rule set: the built-in SLO burn rule, the quality set
+    # (ISSUE 20 — armed alongside, never inside default_rules), then
+    # the env rule.
+    from sav_tpu.obs.alerts import quality_rules
+
+    assert [r.name for r in telemetry.alerts.rules] == (
+        ["slo-burn"] + [r.name for r in quality_rules()] + ["hot-p99"]
+    )
     # A measured 20 ms step at max_batch 8 -> 400 rows/s capacity.
     telemetry.window.observe_window(
         latencies_s=[0.08], overruns_s=[], bucket=8, queue_depth=1,

@@ -64,6 +64,11 @@ REPLICA_COLUMNS = (
     ("inflight", "infl"),
     ("capacity_rps", "cap_rps"),
     ("burn_rate", "burn"),
+    # Prediction-quality beat fields (ISSUE 20, docs/quality.md) — the
+    # rollup carries them only for quality-instrumented replicas, so
+    # the cells honestly render "-" everywhere else.
+    ("quality_churn", "churn"),
+    ("quality_probe_ok_frac", "probe_ok"),
 )
 
 ROUTER_COLUMNS = (
@@ -71,6 +76,11 @@ ROUTER_COLUMNS = (
     ("router_overhead_ms", "ovh_ms"),
     ("router_inflight", "infl"),
     ("router_view_age_s", "view_s"),
+    # Shadow agreement scoring (ISSUE 20): min-across-pairs agreement
+    # and the cumulative breach count, from the router's kind=router
+    # beats via the rollup — shadow-less fleets render neither.
+    ("router_shadow_agreement", "agree"),
+    ("router_shadow_breach", "breach"),
 )
 
 _SPARK = "▁▂▃▄▅▆▇█"
@@ -163,11 +173,19 @@ def gather(log_dir: str) -> dict:
     }
 
 
+def _fmt_mean(metric: str, mean: float) -> str:
+    # Fractions (agreement, probe health, churn) need two decimals —
+    # at one, 0.97 agreement and 1.00 are the same cell.
+    if "agree" in metric or "frac" in metric or "churn" in metric:
+        return f"{mean:.2f}"
+    return f"{mean:.1f}"
+
+
 def _cell(row: dict, metric: str) -> str:
     cell = row.get(metric)
     if not cell or not isinstance(cell.get("mean"), (int, float)):
         return "-"
-    return f"{cell['mean']:.1f}"
+    return _fmt_mean(metric, cell["mean"])
 
 
 def render(snapshot: dict, out) -> None:
@@ -202,7 +220,7 @@ def render(snapshot: dict, out) -> None:
     router = snapshot.get("router") or {}
     if router:
         cells = "  ".join(
-            f"{header} {router[metric]['mean']:.1f}"
+            f"{header} {_fmt_mean(metric, router[metric]['mean'])}"
             for metric, header in ROUTER_COLUMNS
             if isinstance((router.get(metric) or {}).get("mean"), (int, float))
         )

@@ -181,8 +181,31 @@ def render(log_dir: str, summary: dict, out) -> None:
                 + f", shed {v.get('shed')}{flame}",
                 file=out,
             )
+            # Prediction-quality beat fields (ISSUE 20): probe health,
+            # present only on probe-instrumented replicas.
+            q = v.get("quality") or {}
+            if q.get("probe_runs"):
+                miss = q.get("probe_mismatch") or 0
+                print(
+                    f"    probes: {q.get('probe_ok', 0)}/"
+                    f"{q['probe_runs']} ok"
+                    + (f", {miss} MISMATCH" if miss else "")
+                    + (
+                        f", {q['probe_shed']} shed"
+                        if q.get("probe_shed") else ""
+                    ),
+                    file=out,
+                )
         # Capacity/headroom fold (ISSUE 19): summed measured
         # capacity_rps stamps vs the Theil-Sen load projection.
+        # Quality fold (ISSUE 20): worst-replica probe health.
+        if fleet_line.get("probe_ok_frac") is not None:
+            pfrac = fleet_line["probe_ok_frac"]
+            pflag = "" if pfrac >= 1.0 else "  <-- PROBE MISMATCH"
+            print(
+                f"  probe health: worst replica {pfrac:.0%} ok{pflag}",
+                file=out,
+            )
         if fleet_line.get("capacity_rps") is not None:
             head = fleet_line.get("headroom_frac")
             print(
@@ -223,6 +246,22 @@ def render(log_dir: str, summary: dict, out) -> None:
             f"{live.get('router_overhead_ms')} ms/req",
             file=out,
         )
+        # Shadow agreement (ISSUE 20): the router's live quality fold.
+        shadow = live.get("shadow")
+        if shadow and shadow.get("scored"):
+            agreement = shadow.get("agreement")
+            print(
+                f"  shadow rank {shadow.get('rank')} "
+                f"[{shadow.get('dtype') or '?'}]: "
+                f"{shadow.get('scored')} scored, "
+                + (
+                    f"agreement {agreement:.2%}"
+                    if isinstance(agreement, (int, float)) else
+                    "agreement —"
+                )
+                + f", {shadow.get('breach', 0)} breach(es)",
+                file=out,
+            )
     layouts = read_layout_notes(log_dir)
     if layouts:
         print(f"Layouts: {len(layouts)} manifest(s)", file=out)

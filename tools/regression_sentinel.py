@@ -172,6 +172,25 @@ METRICS = {
     # zero-filled. Absolute floor 0.02 (two points of headroom):
     # projection noise on a flat history is not a regression.
     "fleet_headroom_frac": (True, 0.02),
+    # Shadow agreement (ISSUE 20 — min over (primary_dtype,
+    # shadow_dtype) pairs of the top-1 agreement rate between live
+    # replies and their mirrored shadow-replica replies;
+    # docs/quality.md). Higher is better: a drop means replicas stopped
+    # agreeing on PREDICTIONS — weight corruption, a bad swap, or a
+    # numerics regression that latency metrics cannot see. Present only
+    # on fleet records with a shadow rank (serve_bench --shadow-rank);
+    # everything else is skipped, not zero-filled — a run without a
+    # shadow is not "zero agreement". Absolute floor: one point of
+    # agreement, the slo_hit_frac rationale.
+    "quality_agreement": (True, 0.01),
+    # Golden-probe pass fraction (probe_ok / probe_runs — fleet records
+    # fold min across replicas; docs/quality.md). Higher is better: a
+    # drop means a replica's logit fingerprint stopped matching the
+    # checked-in reference — wrong weights, silent corruption, or a
+    # numerics change under a fixed executable. Present only on records
+    # whose engines ran probes (--probe-every); probe-less runs are
+    # skipped, not zero-filled. One point of pass rate floor.
+    "probe_ok_frac": (True, 0.01),
 }
 
 EXIT_CLEAN, EXIT_REGRESSION, EXIT_USAGE = 0, 1, 2

@@ -605,13 +605,17 @@ def report_serve(log_dir: str, out, manifests: list = None) -> None:
     # deliberately excludes — scan the full manifest set for it. Found
     # traces keep a fleet-only dir (no per-replica serve manifests)
     # from reading as "no serve telemetry".
-    trace_notes = [
-        note
-        for _, doc in iter_manifests(log_dir)
-        if isinstance(
-            (note := (doc.get("notes") or {}).get("serve_traces")), dict
-        )
-    ]
+    trace_notes = []
+    quality_notes = []
+    for _, doc in iter_manifests(log_dir):
+        notes = doc.get("notes") or {}
+        if isinstance(notes.get("serve_traces"), dict):
+            trace_notes.append(notes["serve_traces"])
+        # notes.quality rides the fleet bench's kind=serve_fleet
+        # manifest (shadow agreement fold) and the engine's kind=serve
+        # manifest (digest/probe snapshot) — ISSUE 20.
+        if isinstance(notes.get("quality"), dict):
+            quality_notes.append(notes["quality"])
     router_export = os.path.join(
         log_dir, "serve_traces", "requests_router.trace.json.gz"
     )
@@ -641,6 +645,12 @@ def report_serve(log_dir: str, out, manifests: list = None) -> None:
                 ),
                 file=out,
             )
+        # Prediction-quality stamps (ISSUE 20, docs/quality.md):
+        # golden-probe health, present only on probe-instrumented runs.
+        pok = metrics.get("serve/probe_ok_frac")
+        if pok is not None:
+            flag = "" if pok >= 1.0 else "  <-- PROBE MISMATCH"
+            print(f"  golden probes: {pok:.0%} ok{flag}", file=out)
     if replicas:
         for proc in sorted(replicas, key=int):
             v = replicas[proc]
@@ -662,6 +672,15 @@ def report_serve(log_dir: str, out, manifests: list = None) -> None:
     # carries summed capacity_rps stamps vs the load projection, and
     # fleet/alerts.jsonl carries the declarative rule engine's events.
     fleet_fold = serve.get("fleet") or {}
+    # Quality fold (ISSUE 20): worst-replica probe health across the
+    # fleet — skip-not-zero-fill, like capacity.
+    if fleet_fold.get("probe_ok_frac") is not None:
+        pfrac = fleet_fold["probe_ok_frac"]
+        pflag = "" if pfrac >= 1.0 else "  <-- PROBE MISMATCH"
+        print(
+            f"  probe health: worst replica {pfrac:.0%} ok{pflag}",
+            file=out,
+        )
     if fleet_fold.get("capacity_rps") is not None:
         head = fleet_fold.get("headroom_frac")
         print(
@@ -673,6 +692,22 @@ def report_serve(log_dir: str, out, manifests: list = None) -> None:
             + (f", headroom {head:.1%}" if head is not None else ""),
             file=out,
         )
+    for note in quality_notes:
+        shadow = note.get("shadow") or {}
+        if shadow.get("scored"):
+            agreement = shadow.get("agreement")
+            print(
+                f"  shadow agreement: rank {shadow.get('rank')} "
+                f"[{shadow.get('dtype') or '?'}], "
+                f"{shadow.get('scored')} scored, "
+                + (
+                    f"agreement {agreement:.2%}"
+                    if isinstance(agreement, (int, float)) else
+                    "agreement —"
+                )
+                + f", {shadow.get('breach', 0)} breach(es)",
+                file=out,
+            )
     from sav_tpu.obs.alerts import episodes as _alert_eps, read_alerts
 
     for rule, entry in sorted(_alert_eps(read_alerts(log_dir)).items()):

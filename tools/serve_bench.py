@@ -103,6 +103,7 @@ def run(args, manifest) -> dict:
         telemetry=not args.no_telemetry,
         heartbeat_secs=args.heartbeat_secs,
         slo_target=args.slo_target,
+        probe_every_s=args.probe_every,
     )
     engine = ServeEngine(config, manifest=manifest)
     rng = np.random.default_rng(0)
@@ -143,6 +144,7 @@ def run(args, manifest) -> dict:
         "rejected_at_submit": rejected,
         "slo": stats.get("slo"),
         "telemetry": stats.get("telemetry"),
+        "quality": stats.get("quality"),
     }
 
 
@@ -156,6 +158,19 @@ def _parse_inject_delay(spec):
     except ValueError:
         raise ValueError(
             f"--inject-delay wants RANK:SECONDS, got {spec!r}"
+        ) from None
+
+
+def _parse_noise_weights(spec):
+    """``"1:0.3"`` -> (rank 1, 0.3 relative weight-noise scale)."""
+    if not spec:
+        return None, 0.0
+    rank, _, scale = str(spec).partition(":")
+    try:
+        return int(rank), float(scale)
+    except ValueError:
+        raise ValueError(
+            f"--noise-weights wants RANK:SCALE, got {spec!r}"
         ) from None
 
 
@@ -196,12 +211,20 @@ def run_fleet(args, manifest) -> dict:
         ])
         watch_ctx.__enter__()
     delay_rank, delay_s = _parse_inject_delay(args.inject_delay)
+    noise_rank, noise_scale = _parse_noise_weights(args.noise_weights)
     env_fn = None
-    if delay_rank is not None and delay_s > 0:
-        env_fn = lambda rank: (  # noqa: E731
-            {"SAV_CHAOS_SERVE_DELAY_S": str(delay_s)}
-            if rank == delay_rank else {}
-        )
+    if (delay_rank is not None and delay_s > 0) or (
+            noise_rank is not None and noise_scale > 0):
+        def env_fn(rank):
+            env = {}
+            if rank == delay_rank and delay_s > 0:
+                env["SAV_CHAOS_SERVE_DELAY_S"] = str(delay_s)
+            # The planted-corruption arm: this replica loads its
+            # weights, then perturbs every float leaf BEFORE any
+            # quantization — the shadow agreement gate must catch it.
+            if rank == noise_rank and noise_scale > 0:
+                env["SAV_CHAOS_NOISE_WEIGHTS"] = str(noise_scale)
+            return env
     pool = fleet_cli.build_pool(args, log_dir, env_fn=env_fn)
     pool.start()
     transport = TcpTransport(log_dir)
@@ -236,6 +259,8 @@ def run_fleet(args, manifest) -> dict:
             workers=args.fleet_workers,
             log_dir=log_dir,
             heartbeat_secs=args.heartbeat_secs,
+            shadow_rank=args.shadow_rank,
+            shadow_frac=args.shadow_frac,
         )
         rng = np.random.default_rng(0)
         payloads = [
@@ -471,6 +496,23 @@ def run_fleet(args, manifest) -> dict:
         out["chaos"] = chaos
     if probe_routed is not None:
         out["probe_routed"] = probe_routed
+    # Prediction-quality headline (docs/quality.md): shadow agreement
+    # from the router's own summary, probe health from the heartbeat
+    # fold. Both skip-not-zero-fill — a run without a shadow rank or
+    # probes must not read as "agreement 0". The shadow block is
+    # re-read POST-close: the scored-fleet summary above is snapshotted
+    # before close() on purpose (probe traffic must not contaminate the
+    # latency numbers), but the shadow worker finishes draining its
+    # mirror queue inside close() — the pre-close block would undercount
+    # every sample still queued at drain time.
+    shadow = (router.summary().get("shadow") if router is not None else None) \
+        or summary.get("shadow") or {}
+    if shadow:
+        summary["shadow"] = shadow
+    if isinstance(shadow.get("agreement"), (int, float)):
+        out["quality_agreement"] = shadow["agreement"]
+    if isinstance(fleet_fold.get("probe_ok_frac"), (int, float)):
+        out["probe_ok_frac"] = fleet_fold["probe_ok_frac"]
     metrics = {
         "fleet/replicas": float(args.replicas),
         "fleet/restarts": float(status["restarts"]),
@@ -492,6 +534,10 @@ def run_fleet(args, manifest) -> dict:
     # replicas, zero-request runs) must not read as "no headroom".
     if isinstance(fleet_fold.get("headroom_frac"), (int, float)):
         metrics["fleet/headroom_frac"] = float(fleet_fold["headroom_frac"])
+    if isinstance(shadow.get("agreement"), (int, float)):
+        metrics["fleet/quality_agreement"] = float(shadow["agreement"])
+    if isinstance(fleet_fold.get("probe_ok_frac"), (int, float)):
+        metrics["fleet/probe_ok_frac"] = float(fleet_fold["probe_ok_frac"])
     manifest.note("metric", out["metric"])
     if platform:
         manifest.note("platform", platform)
@@ -504,6 +550,11 @@ def run_fleet(args, manifest) -> dict:
         "projected_rps": fleet_fold.get("projected_rps"),
         "headroom_frac": fleet_fold.get("headroom_frac"),
     })
+    if shadow or isinstance(fleet_fold.get("probe_ok_frac"), (int, float)):
+        manifest.note("quality", {
+            "shadow": shadow or None,
+            "probe_ok_frac": fleet_fold.get("probe_ok_frac"),
+        })
     if alert_eps:
         out["alerts"] = alert_eps
         manifest.note("alerts", alert_eps)
@@ -617,6 +668,29 @@ def main(argv=None) -> int:
         "straggler arm — the router must shift load away from it)",
     )
     parser.add_argument(
+        "--shadow-rank", type=int, default=None,
+        help="fleet mode: mirror a sampled fraction of completed live "
+        "requests to this replica and score top-1/logit agreement — "
+        "report-only, off the latency path; the shadow rank never "
+        "serves routed traffic (docs/quality.md)",
+    )
+    parser.add_argument(
+        "--shadow-frac", type=float, default=0.05,
+        help="fraction of admitted requests mirrored to the shadow rank",
+    )
+    parser.add_argument(
+        "--probe-every", type=float, default=0.0,
+        help="seconds between golden-probe runs on each replica "
+        "(0 disables): the checked-in probe batch's logit fingerprint "
+        "proves weight integrity across restarts (docs/quality.md)",
+    )
+    parser.add_argument(
+        "--noise-weights", default=None, metavar="RANK:SCALE",
+        help="fleet chaos arm: perturb one replica's float weights at "
+        "load by SCALE*std relative noise — the planted corruption the "
+        "shadow agreement gate must catch",
+    )
+    parser.add_argument(
         "--chaos-kill-rank", type=int, default=None,
         help="fleet mode chaos arm: SIGKILL this replica mid-load; the "
         "line then carries the outage, the warm-restart proof, and the "
@@ -673,6 +747,18 @@ def main(argv=None) -> int:
         # under a quant-labelled line would poison the quant_* baseline.
         parser.error("--quant-weights is a single-engine A/B arm; it "
                      "does not compose with --replicas yet")
+    if args.shadow_rank is not None:
+        # A shadow needs one live rank to mirror FROM plus the shadow
+        # itself; shadowing in single-engine mode has nothing to score.
+        if args.replicas < 2:
+            parser.error("--shadow-rank needs --replicas >= 2 (a live "
+                         "rank plus the mirrored shadow)")
+        if not 0 <= args.shadow_rank < args.replicas:
+            parser.error("--shadow-rank must name one of the replica "
+                         "ranks")
+    if args.noise_weights and not args.replicas:
+        parser.error("--noise-weights is a fleet chaos arm; it needs "
+                     "--replicas")
     if args.manifest is None:
         stamp = f"{time.strftime('%Y%m%d-%H%M%S')}-{os.getpid()}"
         args.manifest = (
@@ -791,6 +877,11 @@ def main(argv=None) -> int:
     if isinstance(slo.get("hit_frac"), (int, float)):
         out["slo_hit_frac"] = slo["hit_frac"]
         out["burn_rate"] = slo.get("burn_rate")
+    quality = result.get("quality") or {}
+    if isinstance(quality.get("probe_ok_frac"), (int, float)):
+        # Probe health rides the line only when probes actually ran —
+        # skip-not-zero-fill, same as slo_hit_frac.
+        out["probe_ok_frac"] = quality["probe_ok_frac"]
     telemetry = result.get("telemetry")
     if telemetry is not None:
         out["telemetry"] = {

@@ -84,6 +84,10 @@ def add_model_args(parser: argparse.ArgumentParser) -> None:
     parser.add_argument("--attn-tune-cache", default=None)
     parser.add_argument("--heartbeat-secs", type=float, default=1.0)
     parser.add_argument("--slo-target", type=float, default=0.99)
+    # Golden-probe cadence (ISSUE 20, docs/quality.md): every N seconds
+    # an IDLE replica fingerprints the checked-in probe batch; 0 (the
+    # default) disables the probe thread.
+    parser.add_argument("--probe-every", type=float, default=0.0)
 
 
 def replica_argv(args, rank: int, log_dir: str) -> list:
@@ -104,6 +108,7 @@ def replica_argv(args, rank: int, log_dir: str) -> list:
         "--deadline-ms", str(args.deadline_ms),
         "--heartbeat-secs", str(args.heartbeat_secs),
         "--slo-target", str(args.slo_target),
+        "--probe-every", str(args.probe_every),
         "--manifest",
         os.path.join(log_dir, f"manifest-serve-r{rank}.json"),
     ]
@@ -178,6 +183,7 @@ def run_replica(args) -> int:
         log_dir=log_dir,
         heartbeat_secs=args.heartbeat_secs,
         slo_target=args.slo_target,
+        probe_every_s=args.probe_every,
     )
     manifest = RunManifest(args.manifest, kind="serve", argv=sys.argv[1:])
     manifest.begin()
@@ -261,11 +267,19 @@ def run_replica(args) -> int:
             except Exception as e:  # noqa: BLE001 — app error, reply honestly
                 self._reply({"ok": False, "error": repr(e)[:300]})
                 return
-            self._reply({
+            reply = {
                 "ok": True,
                 "pred": int(np.argmax(logits)),
                 "rank": rank,
-            })
+            }
+            if header.get("want_logits"):
+                # Shadow agreement scoring (ISSUE 20): the router's
+                # sampled exchanges ask for the full logit row so the
+                # scorer can judge drift magnitude, not just top-1.
+                # float32 -> JSON float round-trips exactly, so the
+                # scorer sees the replica's bits.
+                reply["logits"] = [float(x) for x in logits]
+            self._reply(reply)
 
         def _reply(self, doc: dict) -> None:
             try:

@@ -124,6 +124,39 @@ def render(log_dir: str, summary: dict, out) -> None:
                 + f", burn rate {burn}{flame}",
                 file=out,
             )
+        # Prediction-quality beat fields (ISSUE 20, docs/quality.md):
+        # present only on quality-instrumented replicas — absent is
+        # "feature off", never rendered as zeros.
+        q = v.get("quality") or {}
+        if q.get("n") or q.get("probe_runs"):
+            bits = []
+            if q.get("n"):
+                churn = q.get("churn")
+                shift = q.get("entropy_shift")
+                bits.append(
+                    f"digests n={q['n']}"
+                    + (
+                        f", churn {churn:.2f}"
+                        if isinstance(churn, (int, float)) else ""
+                    )
+                    + (
+                        f", entropy shift {shift:.1f} MAD"
+                        if isinstance(shift, (int, float)) else ""
+                    )
+                )
+            if q.get("probe_runs"):
+                bits.append(
+                    f"probes {q.get('probe_ok', 0)}/{q['probe_runs']} ok"
+                    + (
+                        f" ({q['probe_mismatch']} MISMATCH)"
+                        if q.get("probe_mismatch") else ""
+                    )
+                    + (
+                        f", {q['probe_shed']} shed"
+                        if q.get("probe_shed") else ""
+                    )
+                )
+            print("  quality: " + "; ".join(bits), file=out)
         if v.get("exemplars"):
             print(f"  slow exemplars: {v['exemplars']}", file=out)
     fleet = summary.get("fleet") or {}
@@ -140,6 +173,10 @@ def render(log_dir: str, summary: dict, out) -> None:
         )
     # Capacity/headroom fold (ISSUE 19) — present only when replicas
     # stamped measured capacity_rps.
+    if fleet.get("probe_ok_frac") is not None:
+        frac = fleet["probe_ok_frac"]
+        flag = "" if frac >= 1.0 else "  <-- PROBE MISMATCH"
+        print(f"Probe health: worst replica {frac:.0%} ok{flag}", file=out)
     if fleet.get("capacity_rps") is not None:
         head = fleet.get("headroom_frac")
         print(
@@ -204,6 +241,46 @@ def render(log_dir: str, summary: dict, out) -> None:
                 ),
                 file=out,
             )
+        # Shadow agreement scoring (ISSUE 20): the per-dtype-pair fold,
+        # rendered with each pair's tolerance envelope so an int8
+        # shadow judged against the PR-17 quant envelope reads
+        # differently from a bf16 twin judged bit-tight.
+        shadow = router.get("shadow")
+        if shadow:
+            agreement = shadow.get("agreement")
+            print(
+                f"  shadow: rank {shadow.get('rank')}"
+                f" [{shadow.get('dtype') or '?'}], frac "
+                f"{shadow.get('frac')} — {shadow.get('scored')} scored, "
+                + (
+                    f"agreement {agreement:.2%}"
+                    if isinstance(agreement, (int, float)) else
+                    "agreement —"
+                )
+                + f", {shadow.get('breach', 0)} breach(es), "
+                f"{shadow.get('shed', 0)} shed",
+                file=out,
+            )
+            for key, p in sorted((shadow.get("pairs") or {}).items()):
+                agree = p.get("agreement")
+                print(
+                    f"    {key}: "
+                    + (
+                        f"agreement {agree:.2%}"
+                        if isinstance(agree, (int, float)) else
+                        "agreement —"
+                    )
+                    + f" over {p.get('n')} (envelope rel "
+                    f"{p.get('envelope_rel')}"
+                    + (
+                        f", worst rel diff {p['rel_diff_max']:.4f}"
+                        if isinstance(
+                            p.get("rel_diff_max"), (int, float)
+                        ) else ""
+                    )
+                    + ")",
+                    file=out,
+                )
     live = summary.get("router_live")
     if live:
         w = live.get("w") or {}
@@ -218,6 +295,19 @@ def render(log_dir: str, summary: dict, out) -> None:
             f"{live.get('router_overhead_ms')} ms/req",
             file=out,
         )
+        live_shadow = live.get("shadow")
+        if live_shadow:
+            lagree = live_shadow.get("agreement")
+            print(
+                f"  live shadow: {live_shadow.get('scored')} scored, "
+                + (
+                    f"agreement {lagree:.2%}"
+                    if isinstance(lagree, (int, float)) else
+                    "agreement —"
+                )
+                + f", {live_shadow.get('breach', 0)} breach(es)",
+                file=out,
+            )
         shares = w.get("stage_shares") or {}
         if shares:
             print(
